@@ -1,0 +1,57 @@
+"""flextree-tpu: a TPU-native topology-parameterized collective framework.
+
+Brand-new implementation of the capabilities of
+Youhe-Jiang/AllReduce-Over-MPI ("FlexTree"): hierarchical allreduce with
+configurable per-level tree widths, ring / flat / recursive-halving-doubling
+special cases, an analytical cost model that picks the tree shape, and an A/B
+benchmark harness — re-architected for TPU: schedules lower to
+``lax.psum_scatter`` / ``lax.all_gather`` / ``lax.ppermute`` with
+``axis_index_groups`` under ``shard_map``, so stages ride ICI/DCN and the
+planner factors the device count along physical torus axes.
+"""
+
+from .schedule import (
+    BlockLayout,
+    Operation,
+    Topology,
+    TopologyError,
+    get_stages,
+    owned_blocks,
+    parse_topo,
+    recv_plan,
+    ring_plan,
+    send_plan,
+)
+from .ops import ReduceOp, SUPPORTED_OPS, get_op
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BlockLayout",
+    "Operation",
+    "Topology",
+    "TopologyError",
+    "get_stages",
+    "owned_blocks",
+    "parse_topo",
+    "recv_plan",
+    "ring_plan",
+    "send_plan",
+    "ReduceOp",
+    "SUPPORTED_OPS",
+    "get_op",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy: keep `import flextree_tpu` JAX-free for the pure schedule layer.
+    if name in _PARALLEL_EXPORTS:
+        from . import parallel
+
+        return getattr(parallel, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# Names re-exported lazily from flextree_tpu.parallel (the JAX backend).
+_PARALLEL_EXPORTS = ()

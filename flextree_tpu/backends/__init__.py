@@ -1,0 +1,10 @@
+"""Execution backends for FlexTree schedules.
+
+- ``simulator``: single-process NumPy oracle (message-granular, clamped tails).
+- ``xla``: the real TPU path — schedules lowered to XLA collectives under
+  ``shard_map`` (see ``flextree_tpu.parallel``).
+"""
+
+from .simulator import simulate_allreduce, simulate_ring_allreduce, simulate_tree_allreduce
+
+__all__ = ["simulate_allreduce", "simulate_ring_allreduce", "simulate_tree_allreduce"]

@@ -1,0 +1,5 @@
+"""Reduction ops: the dtype/op support matrix and kernels."""
+
+from .reduce import ReduceOp, SUPPORTED_OPS, check_dtype, get_op
+
+__all__ = ["ReduceOp", "SUPPORTED_OPS", "check_dtype", "get_op"]

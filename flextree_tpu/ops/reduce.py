@@ -1,0 +1,103 @@
+"""Reduction-op registry: which elementwise reductions the framework supports,
+over which dtypes, with NumPy and JAX implementations.
+
+The reference supports MPI_SUM over 11 dtypes and MPI_BAND over 8 integer
+dtypes, aborting on anything else (``allreduce_over_mpi/mpi_mod.hpp:825-874``,
+``handle_reduce``).  We mirror that matrix — translated to TPU-native dtypes
+(float64 exists on CPU backends; bfloat16 replaces long double) — and add the
+other lattice ops (band/bor/bxor/max/min/prod) that fall out for free, since
+our generic reduce path is op-parametric rather than a hand-unrolled switch
+per source count (the reference's ``reduce_sum``/``reduce_band`` kernels,
+``mpi_mod.hpp:246-660``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+__all__ = ["ReduceOp", "get_op", "SUPPORTED_OPS", "check_dtype"]
+
+_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+_INT_DTYPES = ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
+_BOOL_DTYPES = ("bool",)
+
+# The reference's MPI_SUM dtype set (mpi_mod.hpp:827-837) translated to TPU
+# dtypes; MPI_BAND's integer set (mpi_mod.hpp:851-858) plus bool.
+_SUM_DTYPES = _FLOAT_DTYPES + _INT_DTYPES
+_BITWISE_DTYPES = _INT_DTYPES + _BOOL_DTYPES
+_ORDER_DTYPES = _FLOAT_DTYPES + _INT_DTYPES
+
+
+@dataclass(frozen=True)
+class ReduceOp:
+    """An associative+commutative elementwise reduction.
+
+    ``np_fn``/``jnp_name`` are binary; collectives fold them over peer copies.
+    ``identity`` is the neutral element used when padding buffers so that the
+    padded tail never corrupts real data.
+    """
+
+    name: str
+    np_fn: Callable[[np.ndarray, np.ndarray], np.ndarray]
+    jnp_name: str  # attribute on jax.numpy, resolved lazily (keep this module JAX-free)
+    dtypes: tuple[str, ...]
+    identity: Callable[[np.dtype], object]
+
+    def check_dtype(self, dtype) -> None:
+        check_dtype(self, dtype)
+
+    def identity_for(self, dtype) -> object:
+        return self.identity(np.dtype(dtype))
+
+
+def _all_ones(dt: np.dtype):
+    if dt == np.bool_:
+        return True
+    return dt.type(~dt.type(0))  # all bits set
+
+
+def _min_value(dt: np.dtype):
+    return -np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).min
+
+
+def _max_value(dt: np.dtype):
+    return np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).max
+
+
+SUPPORTED_OPS: dict[str, ReduceOp] = {
+    op.name: op
+    for op in [
+        ReduceOp("sum", np.add, "add", _SUM_DTYPES, lambda dt: dt.type(0)),
+        ReduceOp("prod", np.multiply, "multiply", _SUM_DTYPES, lambda dt: dt.type(1)),
+        ReduceOp("max", np.maximum, "maximum", _ORDER_DTYPES, _min_value),
+        ReduceOp("min", np.minimum, "minimum", _ORDER_DTYPES, _max_value),
+        ReduceOp("band", np.bitwise_and, "bitwise_and", _BITWISE_DTYPES, _all_ones),
+        ReduceOp("bor", np.bitwise_or, "bitwise_or", _BITWISE_DTYPES, lambda dt: dt.type(0)),
+        ReduceOp("bxor", np.bitwise_xor, "bitwise_xor", _BITWISE_DTYPES, lambda dt: dt.type(0)),
+    ]
+}
+
+
+def get_op(op: "str | ReduceOp") -> ReduceOp:
+    """Resolve an op name (or pass through a ReduceOp).  Unknown ops raise,
+    mirroring the reference's abort on unsupported MPI ops
+    (``mpi_mod.hpp:875-877``)."""
+    if isinstance(op, ReduceOp):
+        return op
+    try:
+        return SUPPORTED_OPS[op]
+    except KeyError:
+        raise ValueError(
+            f"unsupported reduce op {op!r}; supported: {sorted(SUPPORTED_OPS)}"
+        ) from None
+
+
+def check_dtype(op: ReduceOp, dtype) -> None:
+    """Raise if ``dtype`` is outside the op's supported matrix (the analog of
+    the reference's per-dtype dispatch aborting, ``mpi_mod.hpp:838-841``)."""
+    name = "bfloat16" if "bfloat16" in str(dtype) else np.dtype(dtype).name
+    if name not in op.dtypes:
+        raise TypeError(f"op {op.name!r} does not support dtype {name}")

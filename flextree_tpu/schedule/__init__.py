@@ -1,0 +1,33 @@
+"""Pure schedule/topology layer — no JAX, no devices.
+
+The TPU-native analog of the reference's L2 layer (``mpi_mod.hpp:45-214,
+882-929``), kept transport-free by design.
+"""
+
+from .stages import Topology, TopologyError, parse_topo, get_stages, FT_TOPO_ENV
+from .blocks import BlockLayout
+from .plan import (
+    Operation,
+    tree_block_set,
+    send_plan,
+    recv_plan,
+    owned_blocks,
+    ring_plan,
+    format_plan,
+)
+
+__all__ = [
+    "Topology",
+    "TopologyError",
+    "parse_topo",
+    "get_stages",
+    "FT_TOPO_ENV",
+    "BlockLayout",
+    "Operation",
+    "tree_block_set",
+    "send_plan",
+    "recv_plan",
+    "owned_blocks",
+    "ring_plan",
+    "format_plan",
+]

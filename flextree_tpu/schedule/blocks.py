@@ -1,0 +1,71 @@
+"""Block layout math: how a flat buffer of ``count`` elements is split into
+``num_nodes`` equal blocks, including the possibly-empty tail blocks.
+
+Mirrors ``FlexTree_Context`` in the reference
+(``allreduce_over_mpi/mpi_mod.hpp:216-243``): ``split_size =
+ceil(count / num_nodes)`` and ``data_size_aligned = split_size * num_nodes``,
+so with N=10 and count=1 nine of the ten blocks are empty — tail clamping is
+therefore a first-class concern (``mpi_mod.hpp:236``, and the clamp sites at
+``:679-696``, ``:725-760``, ``:791-800``).
+
+On TPU we instead *pad* the buffer up to ``data_size_aligned`` (XLA
+collectives want uniform shards), but the schedule layer still exposes exact
+(start, length) spans so the NumPy simulator can reproduce the reference's
+clamped semantics bit-for-bit and tests can check the tail handling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["BlockLayout"]
+
+
+@dataclass(frozen=True)
+class BlockLayout:
+    """Splits ``count`` elements into ``num_nodes`` blocks of ``split_size``.
+
+    Attributes mirror the reference context fields:
+      split_size        -> ``mpi_mod.hpp:231``
+      count_aligned     -> ``data_size_aligned`` (``mpi_mod.hpp:232``)
+    """
+
+    num_nodes: int
+    count: int
+    split_size: int = field(init=False)
+    count_aligned: int = field(init=False)
+
+    def __post_init__(self):
+        if self.num_nodes < 1:
+            raise ValueError(f"num_nodes must be >= 1, got {self.num_nodes}")
+        if self.count < 0:
+            raise ValueError(f"count must be >= 0, got {self.count}")
+        split = -(-self.count // self.num_nodes) if self.count else 0
+        object.__setattr__(self, "split_size", split)
+        object.__setattr__(self, "count_aligned", split * self.num_nodes)
+
+    @property
+    def pad(self) -> int:
+        """Elements of padding needed to reach the aligned size."""
+        return self.count_aligned - self.count
+
+    def span(self, block: int) -> tuple[int, int]:
+        """(start, length) of ``block`` within the *unpadded* buffer.
+
+        Tail blocks are clamped to the true data size and may be empty —
+        the reference's ``start + split_size > data_size`` truncation
+        (``mpi_mod.hpp:679-696``).
+        """
+        if not 0 <= block < self.num_nodes:
+            raise IndexError(f"block {block} out of range [0, {self.num_nodes})")
+        start = block * self.split_size
+        if start >= self.count:
+            return (min(start, self.count), 0)
+        return (start, min(self.split_size, self.count - start))
+
+    def is_empty(self, block: int) -> bool:
+        return self.span(block)[1] == 0
+
+    def slices(self) -> list[slice]:
+        """Python slices for every block, clamped to the unpadded buffer."""
+        return [slice(s, s + l) for s, l in (self.span(b) for b in range(self.num_nodes))]

@@ -1,0 +1,17 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+
+The reference had no tests and targeted a real 16-host cluster
+(SURVEY §4); we simulate multi-chip with
+``--xla_force_host_platform_device_count`` so the whole suite runs anywhere.
+"""
+
+import os
+
+# Must happen before any jax import anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

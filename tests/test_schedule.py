@@ -1,0 +1,270 @@
+"""Unit + property tests for the pure schedule layer (no JAX).
+
+Covers the invariants stated in SURVEY §3.2 and the ``FT_TOPO`` semantics of
+the reference's ``get_stages`` (``mpi_mod.hpp:882-929``).
+"""
+
+import math
+
+import pytest
+
+from flextree_tpu.schedule import (
+    BlockLayout,
+    Operation,
+    Topology,
+    TopologyError,
+    get_stages,
+    owned_blocks,
+    parse_topo,
+    recv_plan,
+    ring_plan,
+    send_plan,
+    format_plan,
+    tree_block_set,
+)
+
+
+# ---------------------------------------------------------------- stages ----
+
+
+class TestGetStages:
+    def test_empty_spec_is_flat(self):
+        assert get_stages(8, "") == (8,)
+
+    def test_parse(self):
+        assert parse_topo(" 4 , 2 ") == (4, 2)
+        assert parse_topo("") == ()
+
+    def test_any_one_means_ring(self):
+        assert get_stages(8, "1") == (1,)
+        assert get_stages(8, "2,1,4") == (1,)
+
+    def test_invalid_width_not_masked_by_ring_sentinel(self):
+        # a zero/negative width must raise even when a 1 is also present
+        with pytest.raises(TopologyError):
+            get_stages(8, "1,0")
+        with pytest.raises(TopologyError):
+            get_stages(8, "1,-3")
+
+    def test_product_must_match(self):
+        with pytest.raises(TopologyError):
+            get_stages(8, "4,3")
+
+    def test_valid(self):
+        assert get_stages(8, "4,2") == (4, 2)
+        assert get_stages(8, "2,2,2") == (2, 2, 2)
+
+    def test_env_var(self, monkeypatch):
+        monkeypatch.setenv("FT_TOPO", "2,4")
+        assert get_stages(8) == (2, 4)
+        monkeypatch.delenv("FT_TOPO")
+        assert get_stages(8) == (8,)
+
+    def test_bad_token(self):
+        with pytest.raises(TopologyError):
+            get_stages(8, "2,x")
+
+
+class TestTopology:
+    def test_flat(self):
+        t = Topology.flat(6)
+        assert t.widths == (6,) and t.gaps == (1,) and not t.is_ring
+
+    def test_halving_doubling(self):
+        t = Topology.halving_doubling(8)
+        assert t.widths == (2, 2, 2)
+        assert t.gaps == (1, 2, 4)
+        with pytest.raises(TopologyError):
+            Topology.halving_doubling(6)
+
+    def test_ring_sentinel(self):
+        t = Topology.ring(5)
+        assert t.is_ring and t.message_steps == 8
+
+    def test_resolve(self):
+        assert Topology.resolve(8, None).widths == (8,)
+        assert Topology.resolve(8, "4,2").widths == (4, 2)
+        assert Topology.resolve(8, (2, 4)).widths == (2, 4)
+        assert Topology.resolve(8, [1]).is_ring
+        t = Topology(8, (4, 2))
+        assert Topology.resolve(8, t) is t
+        with pytest.raises(TopologyError):
+            Topology.resolve(4, t)
+
+    def test_message_steps(self):
+        assert Topology(8, (4, 2)).message_steps == 2 * (3 + 1)
+        assert Topology(8, (8,)).message_steps == 14
+
+    def test_group_members_partition(self):
+        """Every stage's groups partition the rank set."""
+        for widths in [(4, 2), (2, 2, 2), (3, 4), (2, 3, 2), (12,)]:
+            n = math.prod(widths)
+            t = Topology(n, widths)
+            for i in range(t.num_stages):
+                groups = t.groups(i)
+                flat = sorted(r for grp in groups for r in grp)
+                assert flat == list(range(n)), (widths, i)
+                assert all(len(g) == widths[i] for g in groups)
+                # strides within a group equal the gap
+                for g in groups:
+                    assert all(b - a == t.gaps[i] for a, b in zip(g, g[1:]))
+
+    def test_str(self):
+        assert str(Topology(8, (4, 2))) == "4*2"
+
+
+# ---------------------------------------------------------------- blocks ----
+
+
+class TestBlockLayout:
+    def test_even(self):
+        l = BlockLayout(4, 8)
+        assert l.split_size == 2 and l.count_aligned == 8 and l.pad == 0
+        assert l.span(3) == (6, 2)
+
+    def test_tail_clamp(self):
+        l = BlockLayout(4, 7)
+        assert l.split_size == 2 and l.pad == 1
+        assert l.span(3) == (6, 1)
+
+    def test_many_empty_blocks(self):
+        # the reference's N=10, count=1 worked example (mpi_mod.hpp:236)
+        l = BlockLayout(10, 1)
+        assert l.split_size == 1
+        assert l.span(0) == (0, 1)
+        assert all(l.is_empty(b) for b in range(1, 10))
+
+    def test_zero_count(self):
+        l = BlockLayout(3, 0)
+        assert l.split_size == 0 and l.count_aligned == 0
+
+    def test_slices_cover_exactly(self):
+        for n, c in [(4, 7), (10, 1), (3, 9), (8, 64), (5, 5)]:
+            l = BlockLayout(n, c)
+            seen = []
+            for s in l.slices():
+                seen.extend(range(s.start, s.stop))
+            assert seen == list(range(c))
+
+
+# ------------------------------------------------------------------ plan ----
+
+
+def _stage_stride(topo, i):
+    return topo.gaps[i] * topo.widths[i]
+
+
+class TestTreePlan:
+    @pytest.mark.parametrize("widths", [(4,), (2, 2), (4, 2), (2, 2, 2), (3, 4), (2, 3, 2), (5, 3)])
+    def test_send_blocks_are_peer_residues(self, widths):
+        n = math.prod(widths)
+        t = Topology(n, widths)
+        for r in range(n):
+            sp = send_plan(t, r)
+            for i in range(t.num_stages):
+                stride = _stage_stride(t, i)
+                peers = t.group_members(i, r)
+                assert tuple(op.peer for op in sp[i]) == peers
+                for op in sp[i]:
+                    assert op.blocks == tree_block_set(op.peer, n, stride)
+
+    @pytest.mark.parametrize("widths", [(4, 2), (2, 2, 2), (3, 4)])
+    def test_recv_blocks_are_own_residues(self, widths):
+        n = math.prod(widths)
+        t = Topology(n, widths)
+        for r in range(n):
+            rp = recv_plan(t, r)
+            for i in range(t.num_stages):
+                stride = _stage_stride(t, i)
+                mine = tree_block_set(r, n, stride)
+                for op in rp[i]:
+                    assert op.blocks == mine
+
+    @pytest.mark.parametrize("widths", [(4,), (4, 2), (2, 2, 2), (3, 4), (2, 3, 2), (6, 2)])
+    def test_stage_sends_partition_held_blocks(self, widths):
+        """At stage i, the blocks rank r sends to its group partition r's
+        currently-held residue chain {b ≡ r mod gap} — nothing lost, nothing
+        duplicated (SURVEY §3.2)."""
+        n = math.prod(widths)
+        t = Topology(n, widths)
+        for r in range(n):
+            sp = send_plan(t, r)
+            for i in range(t.num_stages):
+                held = set(tree_block_set(r, n, t.gaps[i]))
+                sent = [b for op in sp[i] for b in op.blocks]
+                assert sorted(sent) == sorted(held), (widths, r, i)
+
+    @pytest.mark.parametrize("widths", [(4,), (4, 2), (2, 2, 2), (3, 4), (2, 3, 2)])
+    def test_final_ownership_is_one_block_per_rank(self, widths):
+        n = math.prod(widths)
+        t = Topology(n, widths)
+        owned = [owned_blocks(t, r) for r in range(n)]
+        assert all(len(o) == 1 for o in owned)
+        assert sorted(o[0] for o in owned) == list(range(n))
+        for r in range(n):
+            assert owned[r][0] == r  # b ≡ r (mod N)
+
+    def test_ownership_chain_shrinks(self):
+        t = Topology(12, (2, 3, 2))
+        for r in range(12):
+            prev = set(range(12))
+            for i in range(1, t.num_stages + 1):
+                cur = set(owned_blocks(t, r, i))
+                assert cur <= prev and len(cur) == 12 // math.prod(t.widths[:i])
+                prev = cur
+
+    def test_send_recv_are_symmetric(self):
+        """If r sends block set B to p at stage i, then p's recv plan expects
+        exactly B from r."""
+        t = Topology(12, (3, 4))
+        sps = [send_plan(t, r) for r in range(12)]
+        rps = [recv_plan(t, r) for r in range(12)]
+        for r in range(12):
+            for i in range(t.num_stages):
+                for op in sps[r][i]:
+                    match = [o for o in rps[op.peer][i] if o.peer == r]
+                    assert len(match) == 1
+                    assert match[0].blocks == op.blocks
+
+    def test_format_plan_smoke(self):
+        out = format_plan(Topology(8, (4, 2)), 3)
+        assert "stage0" in out and "stage1" in out
+
+
+class TestRingPlan:
+    def test_matches_reference_walk(self):
+        n = 4
+        for r in range(n):
+            steps = ring_plan(n, r)
+            assert len(steps) == 2 * (n - 1)
+            send0, recv0 = steps[0]
+            assert send0.peer == (r + 1) % n and send0.blocks == (r,)
+            assert recv0.peer == (r - 1) % n and recv0.blocks == ((r - 1) % n,)
+
+    def test_sends_match_recvs(self):
+        n = 5
+        plans = [ring_plan(n, r) for r in range(n)]
+        for step in range(2 * (n - 1)):
+            for r in range(n):
+                send_op, _ = plans[r][step]
+                _, recv_op = plans[send_op.peer][step]
+                assert recv_op.peer == r
+                assert recv_op.blocks == send_op.blocks
+
+    def test_reduce_scatter_converges(self):
+        """After N-1 reduce steps rank r has fully reduced block (r+1) mod N."""
+        n = 6
+        for r in range(n):
+            steps = ring_plan(n, r)
+            last_recv = steps[n - 2][1]
+            assert last_recv.blocks == (((r + 1) % n),)
+
+
+class TestOperation:
+    def test_strided_ctor(self):
+        # Operation(peer=5, total=12, gap=4) -> {1, 5, 9} (mpi_mod.hpp:56-64)
+        op = Operation.strided(5, 12, 4)
+        assert op.blocks == (1, 5, 9)
+
+    def test_single_ctor(self):
+        assert Operation.single(3, 7).blocks == (7,)

@@ -1,0 +1,115 @@
+"""NumPy-simulator correctness: every topology/shape against dense ground
+truth, plus the dtype/op matrix and the reference's edge cases."""
+
+import math
+
+import numpy as np
+import pytest
+
+from flextree_tpu.backends import simulate_allreduce
+from flextree_tpu.ops import SUPPORTED_OPS, get_op
+from flextree_tpu.schedule import Topology
+
+RNG = np.random.default_rng(0)
+
+TOPOS = [
+    (4, (4,)),        # flat
+    (4, (2, 2)),      # halving-doubling
+    (8, (2, 2, 2)),
+    (8, (4, 2)),
+    (8, (2, 4)),
+    (8, (8,)),
+    (12, (3, 4)),
+    (12, (2, 3, 2)),
+    (6, (6,)),
+    (9, (3, 3)),
+    (16, (4, 4)),
+]
+
+
+def _dense(op, data):
+    fn = get_op(op).np_fn
+    acc = data[0].copy()
+    for row in data[1:]:
+        acc = fn(acc, row)
+    return acc
+
+
+@pytest.mark.parametrize("n,widths", TOPOS)
+@pytest.mark.parametrize("count", [1, 5, 35, 64, 100])
+def test_tree_matches_dense_sum(n, widths, count):
+    data = RNG.standard_normal((n, count)).astype(np.float64)
+    out = simulate_allreduce(data, widths)
+    np.testing.assert_allclose(out, np.tile(_dense("sum", data), (n, 1)), rtol=1e-12)
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5, 8])
+@pytest.mark.parametrize("count", [1, 7, 35, 40])
+def test_ring_matches_dense_sum(n, count):
+    data = RNG.standard_normal((n, count)).astype(np.float64)
+    out = simulate_allreduce(data, (1,))
+    np.testing.assert_allclose(out, np.tile(_dense("sum", data), (n, 1)), rtol=1e-12)
+
+
+def test_count_smaller_than_ranks():
+    """N=10, count=1: nine empty blocks (mpi_mod.hpp:236)."""
+    data = RNG.standard_normal((10, 1))
+    for topo in [(10,), (2, 5), (1,)]:
+        out = simulate_allreduce(data, topo)
+        np.testing.assert_allclose(out, np.tile(data.sum(0), (10, 1)))
+
+
+def test_single_rank_fast_path():
+    data = RNG.standard_normal((1, 9))
+    out = simulate_allreduce(data, None)
+    np.testing.assert_array_equal(out, data)
+
+
+@pytest.mark.parametrize("opname", sorted(SUPPORTED_OPS))
+def test_all_ops_integer(opname):
+    data = RNG.integers(1, 50, size=(8, 33)).astype(np.int64)
+    for topo in [(8,), (2, 2, 2), (4, 2), (1,)]:
+        out = simulate_allreduce(data, topo, op=opname)
+        np.testing.assert_array_equal(out, np.tile(_dense(opname, data), (8, 1)))
+
+
+def test_band_matches_reference_semantics():
+    data = RNG.integers(0, 2**31, size=(6, 20)).astype(np.int32)
+    out = simulate_allreduce(data, (3, 2), op="band")
+    expect = data[0]
+    for row in data[1:]:
+        expect = expect & row
+    np.testing.assert_array_equal(out[0], expect)
+
+
+def test_band_rejects_float():
+    data = RNG.standard_normal((4, 8)).astype(np.float32)
+    with pytest.raises(TypeError):
+        simulate_allreduce(data, (4,), op="band")
+
+
+def test_unknown_op_raises():
+    with pytest.raises(ValueError):
+        simulate_allreduce(np.ones((4, 4)), (4,), op="weird")
+
+
+def test_env_topo_used(monkeypatch):
+    data = RNG.standard_normal((8, 16))
+    monkeypatch.setenv("FT_TOPO", "4,2")
+    out = simulate_allreduce(data, None)
+    np.testing.assert_allclose(out, np.tile(data.sum(0), (8, 1)))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int16, np.uint8])
+def test_sum_dtype_matrix(dtype):
+    data = RNG.integers(0, 4, size=(4, 10)).astype(dtype)
+    out = simulate_allreduce(data, (2, 2))
+    np.testing.assert_array_equal(out[0], data.sum(0).astype(dtype))
+
+
+@pytest.mark.parametrize("n,widths", TOPOS)
+def test_ring_and_tree_agree(n, widths):
+    data = RNG.standard_normal((n, 37))
+    t = simulate_allreduce(data, widths)
+    r = simulate_allreduce(data, (1,))
+    np.testing.assert_allclose(t, r, rtol=1e-12)
