@@ -54,4 +54,13 @@ def __getattr__(name):
 
 
 # Names re-exported lazily from flextree_tpu.parallel (the JAX backend).
-_PARALLEL_EXPORTS = ()
+_PARALLEL_EXPORTS = (
+    "allreduce",
+    "tree_allreduce",
+    "ring_allreduce",
+    "reduce_scatter",
+    "allgather",
+    "allreduce_over_mesh",
+    "flat_mesh",
+    "topology_from_mesh",
+)

@@ -60,11 +60,17 @@ def _all_ones(dt: np.dtype):
 
 
 def _min_value(dt: np.dtype):
-    return -np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).min
+    # np.issubdtype is False for ml_dtypes floats (bfloat16 has kind 'V'),
+    # so classify by "not integer/bool" rather than "is np.floating".
+    if np.issubdtype(dt, np.integer):
+        return np.iinfo(dt).min
+    return dt.type(-np.inf)
 
 
 def _max_value(dt: np.dtype):
-    return np.inf if np.issubdtype(dt, np.floating) else np.iinfo(dt).max
+    if np.issubdtype(dt, np.integer):
+        return np.iinfo(dt).max
+    return dt.type(np.inf)
 
 
 SUPPORTED_OPS: dict[str, ReduceOp] = {

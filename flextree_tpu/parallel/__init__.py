@@ -1,0 +1,15 @@
+"""JAX/XLA collective backend: the TPU-native transport + algorithm layers."""
+
+from .allreduce import allgather, allreduce, reduce_scatter, ring_allreduce, tree_allreduce
+from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
+
+__all__ = [
+    "allreduce",
+    "tree_allreduce",
+    "ring_allreduce",
+    "reduce_scatter",
+    "allgather",
+    "allreduce_over_mesh",
+    "flat_mesh",
+    "topology_from_mesh",
+]

@@ -27,7 +27,6 @@ Invariants (property-tested in ``tests/test_schedule.py``):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Sequence
 
 from .stages import Topology
 

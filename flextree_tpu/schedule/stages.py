@@ -23,7 +23,6 @@ from __future__ import annotations
 import math
 import os
 from dataclasses import dataclass, field
-from typing import Iterable, Sequence
 
 __all__ = ["Topology", "TopologyError", "parse_topo", "get_stages", "FT_TOPO_ENV"]
 

@@ -1,17 +1,22 @@
-"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+"""Test harness config: force an 8-device virtual CPU mesh for the suite.
 
-The reference had no tests and targeted a real 16-host cluster
-(SURVEY §4); we simulate multi-chip with
-``--xla_force_host_platform_device_count`` so the whole suite runs anywhere.
+The reference had no tests and targeted a real 16-host cluster (SURVEY §4);
+we simulate multi-chip on CPU so the whole suite runs anywhere.
+
+Gotchas in this container (axon TPU plugin):
+- ``JAX_PLATFORMS=cpu`` in the env is ignored (the plugin re-pins ``axon``
+  from sitecustomize at interpreter start), and env tweaks from inside
+  Python are too late.
+- ``jax.config.update('jax_platform_name', 'cpu')`` selects CPU but still
+  *initializes* every registered backend, including axon — which can hang
+  indefinitely if the TPU tunnel is busy/wedged.
+- The reliable lever is ``jax.config.update('jax_platforms', 'cpu')``:
+  only the CPU backend is ever initialized.  Must run before anything calls
+  ``jax.devices()`` — conftest import time is early enough.
 """
 
-import os
+import jax
 
-# Must happen before any jax import anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
