@@ -223,12 +223,27 @@ def reduce_scatter(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
     return _tree_reduce_scatter(v, axis_name, topo, rop)
 
 
-def allgather(x: jax.Array, axis_name, topo=None) -> jax.Array:
-    """Phase 2 alone: inverse of ``reduce_scatter`` on the same topology."""
+def allgather(x: jax.Array, axis_name, topo=None, out_shape=None) -> jax.Array:
+    """Phase 2 alone: inverse of ``reduce_scatter`` on the same topology.
+
+    ``out_shape``: the original (pre-``reduce_scatter``) array shape.  When
+    the element count wasn't divisible by N, ``reduce_scatter`` padded to
+    ``split_size*N`` (``data_size_aligned``, ``mpi_mod.hpp:232``); passing
+    ``out_shape`` slices that padding back off and restores the shape, so
+    ``allgather(reduce_scatter(x, ...), ..., out_shape=x.shape)`` is a full
+    allreduce for any count.
+    """
     n = lax.axis_size(axis_name)
     if n <= 1:
-        return x
-    topo = Topology.resolve(n, topo)
-    if topo.is_ring:
-        topo = Topology.flat(n)
-    return _tree_allgather(x, axis_name, topo)
+        pass
+    else:
+        topo = Topology.resolve(n, topo)
+        if topo.is_ring:
+            topo = Topology.flat(n)
+        x = _tree_allgather(x, axis_name, topo)
+    if out_shape is not None:
+        count = 1
+        for d in out_shape:
+            count *= d
+        x = x.reshape(-1)[:count].reshape(out_shape)
+    return x

@@ -15,7 +15,7 @@ import math
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..schedule.stages import Topology, TopologyError
+from ..schedule.stages import Topology
 from .allreduce import allreduce
 
 __all__ = ["allreduce_over_mesh", "topology_from_mesh", "flat_mesh"]
@@ -46,9 +46,9 @@ def topology_from_mesh(mesh: Mesh, axis_name=None) -> Topology:
     else:
         widths = tuple(mesh.shape[a] for a in mesh.axis_names)
         n = mesh.size
-    widths = tuple(w for w in widths if w > 1) or (n,)
     if n == 1:
-        return Topology(1, (1,)) if widths == (1,) else Topology.flat(1)
+        return Topology.flat(1)
+    widths = tuple(w for w in widths if w > 1) or (n,)
     return Topology(n, widths)
 
 

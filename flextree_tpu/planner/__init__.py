@@ -1,0 +1,62 @@
+"""Offline planner: candidate enumeration + TPU cost model + chooser.
+
+The rebuild of the reference's ``cost_model/`` + ``topo_count/`` subsystems.
+Unlike the reference (where the planner is a separate binary whose printed
+width vector a human pastes into ``FT_TOPO``, SURVEY §1), ours is importable
+by the runtime — ``choose_topology(...).topology`` drops straight into
+``allreduce(topo=...)`` — while remaining usable offline via
+``python -m flextree_tpu.planner``.  A native C++ core (``native/``)
+accelerates the enumeration/argmin path, with this package as the
+pure-Python fallback and ground truth.
+"""
+
+from .cost_model import (
+    CostBreakdown,
+    DCN_DEFAULT,
+    ICI_DEFAULT,
+    LinkParams,
+    TpuCostParams,
+    allreduce_cost,
+    bus_bandwidth_GBps,
+    ring_cost,
+)
+from .choose import Candidate, Plan, candidate_topologies, choose_topology
+from .factorize import (
+    count_ordered_factorizations,
+    is_prime,
+    ordered_factorizations,
+    prime_factors,
+)
+from .shapes import format_shape, parse_shape, shape_taxonomy
+from .native import (
+    load_native,
+    native_available,
+    native_choose,
+    native_count_shapes,
+)
+
+__all__ = [
+    "CostBreakdown",
+    "LinkParams",
+    "TpuCostParams",
+    "ICI_DEFAULT",
+    "DCN_DEFAULT",
+    "allreduce_cost",
+    "ring_cost",
+    "bus_bandwidth_GBps",
+    "Candidate",
+    "Plan",
+    "candidate_topologies",
+    "choose_topology",
+    "count_ordered_factorizations",
+    "is_prime",
+    "ordered_factorizations",
+    "prime_factors",
+    "format_shape",
+    "parse_shape",
+    "shape_taxonomy",
+    "load_native",
+    "native_available",
+    "native_choose",
+    "native_count_shapes",
+]

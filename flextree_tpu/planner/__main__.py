@@ -1,0 +1,104 @@
+"""Planner CLI: ``python -m flextree_tpu.planner --n 16 --size-mb 256``.
+
+The offline entry point mirroring the reference's ``cost_model`` binary
+(``cost_model/main.cpp``): enumerate candidate tree shapes for N devices,
+cost each, print the ranked table and the winning ``FT_TOPO`` value.
+``--sweep`` reproduces the reference's N=1..max sweep (shape counts +
+planning time per N, CSV to stdout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from .choose import choose_topology
+from .cost_model import TpuCostParams, LinkParams
+from .factorize import count_ordered_factorizations
+from .native import native_available, native_choose
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flextree_tpu.planner",
+        description="Choose the cheapest allreduce tree shape for N devices.",
+    )
+    ap.add_argument("--n", type=int, default=None, help="device count")
+    ap.add_argument("--size-mb", type=float, default=256.0, help="payload MB per chip")
+    ap.add_argument(
+        "--mesh-shape",
+        type=str,
+        default=None,
+        help="physical torus shape, e.g. 16,16 (enables torus-aligned costing)",
+    )
+    ap.add_argument(
+        "--dcn-axes",
+        type=str,
+        default="",
+        help="comma list of mesh-axis indices that are DCN (multi-slice)",
+    )
+    ap.add_argument("--ici-gbps", type=float, default=45.0)
+    ap.add_argument("--ici-latency-us", type=float, default=1.0)
+    ap.add_argument(
+        "--sweep",
+        type=int,
+        default=None,
+        metavar="NMAX",
+        help="sweep N=2..NMAX, print CSV (n, num_shapes, chosen, plan_us)",
+    )
+    ap.add_argument(
+        "--native",
+        action="store_true",
+        help="use the native C++ core (builds it on first use)",
+    )
+    args = ap.parse_args(argv)
+
+    params = TpuCostParams(
+        ici=LinkParams(bandwidth_GBps=args.ici_gbps, latency_us=args.ici_latency_us)
+    )
+    nbytes = int(args.size_mb * 1e6)
+
+    if args.sweep is not None:
+        # resolve (and if needed build) the native lib before timing starts,
+        # so the first row doesn't report compile time as planning time
+        use_native = args.native and native_available()
+        print("n,num_shapes,chosen,plan_us")
+        for n in range(2, args.sweep + 1):
+            t0 = time.perf_counter()
+            if use_native:
+                widths, _ = native_choose(n, nbytes, params)
+            else:
+                widths = choose_topology(n, nbytes, params).widths
+            dt = (time.perf_counter() - t0) * 1e6
+            shape = "ring" if widths == (1,) else "*".join(map(str, widths))
+            print(f"{n},{count_ordered_factorizations(n)},{shape},{dt:.1f}")
+        return 0
+
+    if args.n is None:
+        ap.error("--n is required unless --sweep is given")
+
+    mesh_shape = (
+        tuple(int(t) for t in args.mesh_shape.split(",")) if args.mesh_shape else None
+    )
+    dcn_axes = (
+        tuple(int(t) for t in args.dcn_axes.split(",")) if args.dcn_axes else ()
+    )
+    plan = choose_topology(
+        args.n, nbytes, params, mesh_shape=mesh_shape, dcn_axes=dcn_axes
+    )
+    print(plan.summary())
+    print(f"FT_TOPO={plan.to_ft_topo()}")
+    if args.native:
+        nat = native_choose(args.n, nbytes, params)
+        if nat is None:
+            print("native core unavailable (build failed?)", file=sys.stderr)
+        else:
+            widths, cost = nat
+            shape = "ring" if widths == (1,) else "*".join(map(str, widths))
+            print(f"native argmin: {shape} ({cost:.1f} µs)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

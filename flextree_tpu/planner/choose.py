@@ -1,0 +1,193 @@
+"""Topology chooser: enumerate candidate tree shapes, cost each, pick argmin.
+
+The rebuild of ``cost_model/ChooseWidth.h`` + ``CostModel.h:82-119``'s
+driver loop: enumerate ordered factorizations, evaluate the cost model,
+return the cheapest shape (the reference prints it; we return a structured
+plan whose ``widths`` drop straight into ``flextree_tpu.allreduce(topo=...)``
+or the ``FT_TOPO`` env var).
+
+Prime/odd device counts: the reference's planner proposes shapes for N±1
+(``ChooseWidth.h:16-21`` — the disabled "lonely node" idea), but its runtime
+aborts unless the width product equals N (``mpi_mod.hpp:914-918``).  We keep
+the same contract: for prime N the usable candidates are the flat tree and
+the ring, and the N±1 shapes are reported as *advisory* (what you'd get by
+resizing the job), matching the reference's printed ``+1``/``-1`` notation.
+
+Torus-aware mode: given a mesh shape (e.g. ``(16, 16)``), only
+factorizations whose widths tile the torus axes in order are physical —
+each stage's groups then ride a single ICI axis.  ``choose_topology``
+prefers those when a mesh shape is provided.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..schedule.stages import Topology
+from .cost_model import CostBreakdown, TpuCostParams, allreduce_cost
+from .factorize import is_prime, ordered_factorizations
+
+__all__ = ["Candidate", "Plan", "choose_topology", "candidate_topologies"]
+
+
+@dataclass(frozen=True)
+class Candidate:
+    widths: tuple[int, ...]
+    cost: CostBreakdown
+    torus_aligned: bool = False
+
+    @property
+    def total_us(self) -> float:
+        return self.cost.total_us
+
+
+@dataclass(frozen=True)
+class Plan:
+    """Chooser output: the winning topology plus the full ranked table."""
+
+    num_nodes: int
+    nbytes: int
+    topology: Topology
+    candidates: tuple[Candidate, ...]  # ranked, cheapest first
+    advisory: tuple[str, ...] = ()  # e.g. prime-N resize suggestions
+
+    @property
+    def widths(self) -> tuple[int, ...]:
+        return self.topology.widths
+
+    def to_ft_topo(self) -> str:
+        """The ``FT_TOPO`` env value selecting this plan."""
+        return ",".join(map(str, self.topology.widths))
+
+    def summary(self) -> str:
+        lines = [
+            f"plan for N={self.num_nodes}, {self.nbytes} bytes: "
+            f"topo {self.topology} ({self.candidates[0].total_us:.1f} µs predicted)"
+        ]
+        for c in self.candidates[:8]:
+            mark = " torus" if c.torus_aligned else ""
+            shape = "ring" if c.widths == (1,) else "*".join(map(str, c.widths))
+            lines.append(
+                f"  {shape:>12}: {c.total_us:9.1f} µs "
+                f"(lat {c.cost.latency_us:.1f} + bw {c.cost.bandwidth_us:.1f} "
+                f"+ red {c.cost.reduce_us:.1f} + ctl {c.cost.control_us:.1f}){mark}"
+            )
+        for a in self.advisory:
+            lines.append(f"  advisory: {a}")
+        return "\n".join(lines)
+
+
+def _is_torus_aligned(widths: tuple[int, ...], mesh_shape: tuple[int, ...]) -> bool:
+    """True if ``widths`` tiles ``mesh_shape`` axis by axis, in order: each
+    mesh axis is covered by a contiguous run of widths whose product equals
+    the axis size (so every stage's groups span exactly one physical axis).
+    Degenerate size-1 axes are ignored (no width can consume them)."""
+    mesh_shape = tuple(s for s in mesh_shape if s > 1)
+    if not mesh_shape:
+        return False
+    ai = 0
+    acc = 1
+    for w in widths:
+        if ai >= len(mesh_shape):
+            return False
+        acc *= w
+        if acc == mesh_shape[ai]:
+            ai += 1
+            acc = 1
+        elif mesh_shape[ai] % acc != 0:
+            return False
+    return ai == len(mesh_shape) and acc == 1
+
+
+def candidate_topologies(n: int) -> list[tuple[int, ...]]:
+    """All usable stage-width vectors for ``n`` devices: every ordered
+    factorization plus the ring sentinel ``(1,)`` (the reference appends
+    flat/ring sentinels in ``GetWidth.h:214-219``)."""
+    shapes: list[tuple[int, ...]] = list(ordered_factorizations(n))
+    shapes.append((1,))
+    return shapes
+
+
+def choose_topology(
+    n: int,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    mesh_shape: tuple[int, ...] | None = None,
+    dcn_axes: tuple[int, ...] = (),
+) -> Plan:
+    """Pick the cheapest topology for ``n`` devices and ``nbytes``/chip.
+
+    ``mesh_shape``: physical torus shape, e.g. ``(16, 16)`` for a v5e-256
+    slice; when given, torus-aligned shapes get exact per-axis costing and
+    non-aligned shapes are penalized implicitly (their stages still cost as
+    single-axis rings, which is optimistic — alignment is reported so the
+    caller can filter).  ``dcn_axes``: indices of mesh axes that are DCN
+    (multi-slice outer axes).
+    """
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    if mesh_shape:
+        # drop degenerate size-1 axes, remapping dcn_axes indices to match
+        keep = [i for i, s in enumerate(mesh_shape) if s > 1]
+        dcn_axes = tuple(keep.index(a) for a in dcn_axes if a in keep)
+        mesh_shape = tuple(mesh_shape[i] for i in keep) or None
+    if n == 1:
+        t = Topology.flat(1)
+        return Plan(1, nbytes, t, (Candidate((1,), allreduce_cost(t, nbytes, params)),))
+
+    cands: list[Candidate] = []
+    for widths in candidate_topologies(n):
+        if widths == (1,):
+            from .cost_model import ring_cost
+
+            cost = ring_cost(n, nbytes, params, crosses_dcn=bool(dcn_axes))
+            cands.append(Candidate((1,), cost, False))
+            continue
+        topo = Topology(n, widths)
+        aligned = _is_torus_aligned(widths, mesh_shape) if mesh_shape else False
+        dcn_stages: tuple[int, ...] = ()
+        if dcn_axes and mesh_shape and widths != (1,):
+            if aligned:
+                # map each stage to its mesh axis; stages landing on DCN
+                # axes pay DCN constants
+                stage_axis = []
+                ai = 0
+                acc = 1
+                for w in widths:
+                    stage_axis.append(ai)
+                    acc *= w
+                    if acc == mesh_shape[ai]:
+                        ai += 1
+                        acc = 1
+                dcn_stages = tuple(
+                    i for i, a in enumerate(stage_axis) if a in set(dcn_axes)
+                )
+            else:
+                # a shape that doesn't tile the torus axes has groups
+                # straddling the DCN boundary: price every stage at DCN
+                # (pessimistic) so misaligned shapes can't win on an
+                # optimistic ICI-only estimate
+                dcn_stages = tuple(range(len(widths)))
+        cost = allreduce_cost(topo, nbytes, params, dcn_stages=dcn_stages)
+        cands.append(Candidate(widths, cost, aligned))
+
+    # prefer torus-aligned shapes at equal cost; then cheapest
+    cands.sort(key=lambda c: (c.total_us, not c.torus_aligned, len(c.widths)))
+    best = cands[0]
+    topo = Topology.ring(n) if best.widths == (1,) else Topology(n, best.widths)
+
+    advisory: tuple[str, ...] = ()
+    if is_prime(n) and n > 3:
+        # the reference's ChooseWidth N±1 suggestion (ChooseWidth.h:16-21)
+        near = []
+        from .shapes import format_shape
+
+        for m, delta in ((n - 1, +1), (n + 1, -1)):
+            alt = choose_topology(m, nbytes, params)
+            near.append(
+                f"N={n} is prime; resizing to {m} would allow "
+                f"topo {format_shape(alt.widths, delta)}"
+            )
+        advisory = tuple(near)
+
+    return Plan(n, nbytes, topo, tuple(cands), advisory)

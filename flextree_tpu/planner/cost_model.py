@@ -1,0 +1,149 @@
+"""Analytical TPU cost model for topology-parameterized allreduce.
+
+Retargets the reference's 3-term model (``cost_model/CostModel.h``:
+latency+control, memory read/write, bandwidth+compute — constants calibrated
+for an Ethernet MPI cluster) to the TPU fabric:
+
+- **latency/control**: each stage-``w`` grouped collective on a torus axis is
+  ``w-1`` neighbor hops (XLA lowers grouped reduce-scatter/all-gather to a
+  ring on the axis), each hop paying the link latency; wide groups add
+  control overhead — the TPU analog of the reference's ``co*(width-9)``
+  wide-group penalty (``CostModel.h:7-10``).
+- **bandwidth**: stage ``i`` moves ``(w_i-1)/w_i * S/g_i`` bytes per chip
+  over that stage's axis.  A telescoping identity makes the *sum* over
+  stages equal ``(N-1)/N * S`` for every factorization — on a uniform
+  fabric, bandwidth does not distinguish shapes (same conclusion as the
+  reference's shape-independent ``bandwidth_calculation_overhead``,
+  ``CostModel.h:22-30``); shapes win on latency and on *per-axis* bandwidth
+  differences (ICI vs DCN), which is the TPU-specific lever.
+- **reduce/memory**: phase-1 accumulation writes ``(w_i-1)/(g_i w_i) * S``
+  bytes per stage at HBM-bound reduce throughput — the analog of
+  ``memory_read_write_overhead`` (``CostModel.h:32-79``) without its
+  per-height unrolled formulas (and without its uninitialized-``cost`` and
+  ignored-``Chunk_size`` bugs, SURVEY §8).
+
+All times in microseconds, sizes in bytes.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from ..schedule.stages import Topology
+
+__all__ = ["LinkParams", "TpuCostParams", "CostBreakdown", "allreduce_cost", "ring_cost"]
+
+
+@dataclass(frozen=True)
+class LinkParams:
+    """One communication domain (an ICI torus axis, or the DCN)."""
+
+    bandwidth_GBps: float  # per-chip injection bandwidth on this domain
+    latency_us: float  # per neighbor-hop / per-message latency
+
+    def time_us(self, nbytes: float) -> float:
+        return nbytes / (self.bandwidth_GBps * 1e3)  # GB/s -> bytes/µs
+
+
+#: TPU v5e-flavored defaults: ICI ~45 GB/s/direction per axis with ~1 µs
+#: neighbor-hop latency; DCN ~ 6 GB/s with tens of µs latency.
+ICI_DEFAULT = LinkParams(bandwidth_GBps=45.0, latency_us=1.0)
+DCN_DEFAULT = LinkParams(bandwidth_GBps=6.0, latency_us=25.0)
+
+
+@dataclass(frozen=True)
+class TpuCostParams:
+    """Fabric + chip constants for the model."""
+
+    ici: LinkParams = ICI_DEFAULT
+    dcn: LinkParams = DCN_DEFAULT
+    # HBM-bound accumulate throughput for the local reduction (read w
+    # copies, write one) — the VPU is never the bottleneck, HBM is.
+    reduce_bw_GBps: float = 400.0
+    # extra control/software overhead per unit of group width beyond 2 —
+    # wide groups put more messages in flight per step (TPU analog of
+    # CostModel.h:7-10's width>9 penalty, smooth instead of a cliff).
+    control_us_per_width: float = 0.05
+    # fixed per-collective launch overhead (dispatch, fusion boundary)
+    launch_us: float = 2.0
+
+
+@dataclass(frozen=True)
+class CostBreakdown:
+    """Predicted time (µs) for one allreduce, by term."""
+
+    latency_us: float
+    bandwidth_us: float
+    reduce_us: float
+    control_us: float
+
+    @property
+    def total_us(self) -> float:
+        return self.latency_us + self.bandwidth_us + self.reduce_us + self.control_us
+
+
+def _stage_links(topo: Topology, params: TpuCostParams, dcn_stages=()) -> list[LinkParams]:
+    return [
+        params.dcn if i in set(dcn_stages) else params.ici
+        for i in range(topo.num_stages)
+    ]
+
+
+def allreduce_cost(
+    topo: Topology,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    dcn_stages: tuple[int, ...] = (),
+) -> CostBreakdown:
+    """Predicted wall time of one allreduce of ``nbytes``/chip with ``topo``.
+
+    ``dcn_stages`` marks stages whose groups cross the DCN (multi-slice):
+    on a 2-slice system with widths ``(16, 2)``, stage 1 rides DCN.
+    """
+    if topo.is_ring:
+        return ring_cost(topo.num_nodes, nbytes, params)
+    links = _stage_links(topo, params, dcn_stages)
+    lat = bw = red = ctl = 0.0
+    for i, w in enumerate(topo.widths):
+        g = topo.gaps[i]
+        link = links[i]
+        stage_bytes = (w - 1) / w * (nbytes / g)  # per chip, per phase
+        hops = w - 1  # ring lowering on the stage's axis
+        # two phases: reduce-scatter down, all-gather back up
+        lat += 2 * (hops * link.latency_us + params.launch_us)
+        bw += 2 * link.time_us(stage_bytes)
+        red += stage_bytes / (params.reduce_bw_GBps * 1e3)  # phase 1 only
+        ctl += 2 * params.control_us_per_width * max(0, w - 2)
+    return CostBreakdown(lat, bw, red, ctl)
+
+
+def ring_cost(
+    n: int,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    crosses_dcn: bool = False,
+) -> CostBreakdown:
+    """Ring algorithm: 2(N-1) neighbor steps, each carrying ``S/N`` bytes
+    (``mpi_mod.hpp:1113-1163``).  Bandwidth-optimal, latency-heaviest.
+
+    ``crosses_dcn``: a ring spanning multiple slices has cross-DCN neighbor
+    links, and every lock-step ring step is gated by its slowest link — so
+    the whole ring prices at DCN constants."""
+    if n <= 1:
+        return CostBreakdown(0.0, 0.0, 0.0, 0.0)
+    link = params.dcn if crosses_dcn else params.ici
+    steps = 2 * (n - 1)
+    per_step_bytes = nbytes / n
+    lat = steps * link.latency_us + 2 * params.launch_us
+    bw = steps * link.time_us(per_step_bytes)
+    red = (n - 1) / n * nbytes / (params.reduce_bw_GBps * 1e3)
+    return CostBreakdown(lat, bw, red, 0.0)
+
+
+def bus_bandwidth_GBps(n: int, nbytes: int, time_us: float) -> float:
+    """Algorithmic (bus) bandwidth ``2(N-1)/N * S / t`` — the reporting
+    metric of BASELINE.md."""
+    if time_us <= 0 or n < 1:
+        return 0.0
+    return (2 * (n - 1) / n) * nbytes / (time_us * 1e3)

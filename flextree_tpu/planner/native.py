@@ -1,0 +1,152 @@
+"""ctypes bindings for the native planner core (``native/flextree_planner.cpp``).
+
+The reference's planner is native C++ (``cost_model/*.h``); ours keeps a
+native core for the hot enumeration/argmin path with a pure-Python fallback
+(``planner.choose``) when the shared library hasn't been built.  Build with
+``make -C native`` (no pybind11 in this image — plain C ABI + ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+from pathlib import Path
+
+from .cost_model import TpuCostParams
+
+__all__ = ["load_native", "native_available", "native_choose", "native_count_shapes"]
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_NAME = "libflextree_planner.so"
+
+
+@functools.lru_cache(maxsize=1)
+def load_native(build_if_missing: bool = True):
+    """Load (building on first use if possible) the native planner library.
+
+    Returns the ctypes CDLL or None if unavailable; all callers must
+    fall back to the Python implementation on None.
+    """
+    lib_path = _NATIVE_DIR / _LIB_NAME
+    if not lib_path.exists() and build_if_missing:
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, OSError):
+            return None
+    if not lib_path.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(lib_path))
+    except OSError:
+        return None
+
+    lib.ft_count_shapes.restype = ctypes.c_uint64
+    lib.ft_count_shapes.argtypes = [ctypes.c_uint64]
+    lib.ft_enumerate_shapes.restype = ctypes.c_int64
+    lib.ft_enumerate_shapes.argtypes = [
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.ft_shape_cost.restype = ctypes.c_double
+    lib.ft_shape_cost.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+    ] + [ctypes.c_double] * 6
+    lib.ft_choose.restype = ctypes.c_int32
+    lib.ft_choose.argtypes = [
+        ctypes.c_uint64,
+    ] + [ctypes.c_double] * 6 + [
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.ft_sweep.restype = ctypes.c_uint64
+    lib.ft_sweep.argtypes = [ctypes.c_uint64] + [ctypes.c_double] * 6
+    return lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def _param_args(params: TpuCostParams):
+    return (
+        params.ici.bandwidth_GBps,
+        params.ici.latency_us,
+        params.reduce_bw_GBps,
+        params.control_us_per_width,
+        params.launch_us,
+    )
+
+
+def native_count_shapes(n: int) -> int | None:
+    lib = load_native()
+    if lib is None:
+        return None
+    return int(lib.ft_count_shapes(n))
+
+
+def native_enumerate_shapes(n: int) -> list[tuple[int, ...]] | None:
+    lib = load_native()
+    if lib is None:
+        return None
+    needed = ctypes.c_uint64(0)
+    lib.ft_enumerate_shapes(n, None, 0, ctypes.byref(needed))
+    buf = (ctypes.c_uint32 * max(1, needed.value))()
+    cnt = lib.ft_enumerate_shapes(n, buf, needed.value, ctypes.byref(needed))
+    if cnt < 0:
+        return None
+    out, off = [], 0
+    for _ in range(cnt):
+        k = buf[off]
+        out.append(tuple(buf[off + 1 : off + 1 + k]))
+        off += 1 + k
+    return out
+
+
+def native_shape_cost(
+    widths: tuple[int, ...], n: int, nbytes: float, params: TpuCostParams
+) -> float | None:
+    lib = load_native()
+    if lib is None:
+        return None
+    arr = (ctypes.c_uint32 * len(widths))(*widths)
+    return float(
+        lib.ft_shape_cost(arr, len(widths), n, float(nbytes), *_param_args(params))
+    )
+
+
+def native_choose(
+    n: int, nbytes: float, params: TpuCostParams = TpuCostParams()
+) -> tuple[tuple[int, ...], float] | None:
+    """Native argmin over candidate shapes; (widths, predicted µs) or None."""
+    lib = load_native()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint32 * 64)()
+    cost = ctypes.c_double(0.0)
+    k = lib.ft_choose(
+        n, float(nbytes), *_param_args(params), out, 64, ctypes.byref(cost)
+    )
+    if k < 0:
+        return None
+    return tuple(out[:k]), float(cost.value)
+
+
+def native_sweep(
+    n_max: int, nbytes: float, params: TpuCostParams = TpuCostParams()
+) -> int | None:
+    lib = load_native()
+    if lib is None:
+        return None
+    return int(lib.ft_sweep(n_max, float(nbytes), *_param_args(params)))

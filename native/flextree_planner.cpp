@@ -1,0 +1,200 @@
+// Native planner core for flextree-tpu.
+//
+// TPU-native rebuild of the reference's offline planner
+// (cost_model/GetWidth.h, CostModel.h, ChooseWidth.h — C++ there, C++ here):
+// ordered-factorization enumeration and analytical allreduce costing, argmin
+// over candidate stage-width vectors.  The cost formulas mirror
+// flextree_tpu/planner/cost_model.py exactly (uniform-fabric path; the
+// mesh-aware DCN path stays in Python).  Exposed as a C ABI for ctypes —
+// no pybind11 in this image.
+//
+// Unlike the reference enumerator, no global mutable state (GetWidth.h:7-8)
+// and no uninitialized cost accumulator (CostModel.h:89).
+//
+// Build: see native/Makefile (g++ -O2 -shared -fPIC).
+
+#include <cstdint>
+#include <algorithm>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct CostParams {
+  double ici_bw_GBps;        // per-chip injection bandwidth
+  double ici_latency_us;     // per neighbor-hop latency
+  double reduce_bw_GBps;     // HBM-bound accumulate throughput
+  double control_us_per_width;
+  double launch_us;          // per-collective dispatch overhead
+};
+
+// DFS over divisors: every ordered factorization of n into factors >= 2,
+// including (n,) itself.  Matches planner/factorize.py::ordered_factorizations
+// (and the reference getWidth's candidate set, minus its global accumulators).
+void enumerate_rec(uint64_t rest, std::vector<uint32_t>& prefix,
+                   std::vector<std::vector<uint32_t>>& out) {
+  // every proper divisor d (2 <= d < rest) can lead a shape; walk the
+  // divisor pairs around sqrt(rest) so cofactors > sqrt are included too
+  std::vector<uint64_t> divs;
+  for (uint64_t d = 2; d * d <= rest; ++d) {
+    if (rest % d == 0) {
+      divs.push_back(d);
+      uint64_t other = rest / d;
+      if (other != d && other != rest) divs.push_back(other);
+    }
+  }
+  std::sort(divs.begin(), divs.end());
+  for (uint64_t d : divs) {
+    prefix.push_back(static_cast<uint32_t>(d));
+    enumerate_rec(rest / d, prefix, out);
+    prefix.pop_back();
+  }
+  if (rest >= 2) {
+    prefix.push_back(static_cast<uint32_t>(rest));
+    out.push_back(prefix);
+    prefix.pop_back();
+  }
+}
+
+std::vector<std::vector<uint32_t>> enumerate_shapes(uint64_t n) {
+  std::vector<std::vector<uint32_t>> out;
+  if (n >= 2) {
+    std::vector<uint32_t> prefix;
+    enumerate_rec(n, prefix, out);
+  }
+  return out;
+}
+
+// Tree allreduce cost — mirrors cost_model.py::allreduce_cost (ICI-only).
+double tree_cost(const uint32_t* widths, uint32_t k, const CostParams& p,
+                 double nbytes) {
+  double lat = 0.0, bw = 0.0, red = 0.0, ctl = 0.0;
+  double gap = 1.0;
+  for (uint32_t i = 0; i < k; ++i) {
+    const double w = static_cast<double>(widths[i]);
+    const double stage_bytes = (w - 1.0) / w * (nbytes / gap);
+    const double hops = w - 1.0;
+    lat += 2.0 * (hops * p.ici_latency_us + p.launch_us);
+    bw += 2.0 * stage_bytes / (p.ici_bw_GBps * 1e3);
+    red += stage_bytes / (p.reduce_bw_GBps * 1e3);
+    if (w > 2.0) ctl += 2.0 * p.control_us_per_width * (w - 2.0);
+    gap *= w;
+  }
+  return lat + bw + red + ctl;
+}
+
+// Ring allreduce cost — mirrors cost_model.py::ring_cost.
+double ring_cost(uint64_t n, const CostParams& p, double nbytes) {
+  if (n <= 1) return 0.0;
+  const double nd = static_cast<double>(n);
+  const double steps = 2.0 * (nd - 1.0);
+  const double per_step = nbytes / nd;
+  const double lat = steps * p.ici_latency_us + 2.0 * p.launch_us;
+  const double bw = steps * per_step / (p.ici_bw_GBps * 1e3);
+  const double red = (nd - 1.0) / nd * nbytes / (p.reduce_bw_GBps * 1e3);
+  return lat + bw + red;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of ordered factorizations of n (factors >= 2), the planner's
+// search-space size (topo_count/factor_count.py analog).  Memo-free
+// iterative DFS count; n is a device count, so depth is tiny.
+uint64_t ft_count_shapes(uint64_t n) {
+  if (n < 2) return 0;
+  uint64_t total = 0;
+  // iterative stack of "rest" values; each pop contributes 1 (the shape
+  // ending with `rest`) and pushes rest/d for each divisor d<=sqrt(rest).
+  std::vector<uint64_t> stack{n};
+  while (!stack.empty()) {
+    uint64_t rest = stack.back();
+    stack.pop_back();
+    ++total;  // (.., rest)
+    for (uint64_t d = 2; d * d <= rest; ++d) {
+      if (rest % d == 0) {
+        stack.push_back(rest / d);
+        uint64_t other = rest / d;
+        if (other != d) stack.push_back(d);
+      }
+    }
+  }
+  return total;
+}
+
+// Enumerate shapes into `buf` as [k, w0, .., w_{k-1}] records.
+// Returns the number of shapes; sets *needed to the required uint32 count.
+// If buf_len is insufficient, writes nothing beyond buf_len and returns -1.
+int64_t ft_enumerate_shapes(uint64_t n, uint32_t* buf, uint64_t buf_len,
+                            uint64_t* needed) {
+  auto shapes = enumerate_shapes(n);
+  uint64_t req = 0;
+  for (const auto& s : shapes) req += 1 + s.size();
+  if (needed) *needed = req;
+  if (req > buf_len || buf == nullptr) return -1;
+  uint64_t off = 0;
+  for (const auto& s : shapes) {
+    buf[off++] = static_cast<uint32_t>(s.size());
+    std::memcpy(buf + off, s.data(), s.size() * sizeof(uint32_t));
+    off += s.size();
+  }
+  return static_cast<int64_t>(shapes.size());
+}
+
+// Cost of a single shape (widths of length k; pass k=1,widths={1} for ring).
+double ft_shape_cost(const uint32_t* widths, uint32_t k, uint64_t n,
+                     double nbytes, double ici_bw, double ici_lat,
+                     double reduce_bw, double ctl_per_width, double launch_us) {
+  CostParams p{ici_bw, ici_lat, reduce_bw, ctl_per_width, launch_us};
+  if (k == 1 && widths[0] == 1) return ring_cost(n, p, nbytes);
+  return tree_cost(widths, k, p, nbytes);
+}
+
+// Argmin over all ordered factorizations of n plus the ring sentinel.
+// Writes the winning widths into `out` (cap `out_cap`), best cost into
+// *best_cost.  Returns the number of widths written, or -1 on error.
+int32_t ft_choose(uint64_t n, double nbytes, double ici_bw, double ici_lat,
+                  double reduce_bw, double ctl_per_width, double launch_us,
+                  uint32_t* out, uint32_t out_cap, double* best_cost) {
+  if (n < 1 || out == nullptr || out_cap == 0) return -1;
+  CostParams p{ici_bw, ici_lat, reduce_bw, ctl_per_width, launch_us};
+  if (n == 1) {
+    out[0] = 1;
+    if (best_cost) *best_cost = 0.0;
+    return 1;
+  }
+  auto shapes = enumerate_shapes(n);
+  double best = ring_cost(n, p, nbytes);
+  std::vector<uint32_t> best_shape{1};  // ring sentinel
+  for (const auto& s : shapes) {
+    double c = tree_cost(s.data(), static_cast<uint32_t>(s.size()), p, nbytes);
+    if (c < best ||
+        (c == best && s.size() < best_shape.size())) {
+      best = c;
+      best_shape = s;
+    }
+  }
+  if (best_shape.size() > out_cap) return -1;
+  std::memcpy(out, best_shape.data(), best_shape.size() * sizeof(uint32_t));
+  if (best_cost) *best_cost = best;
+  return static_cast<int32_t>(best_shape.size());
+}
+
+// Planner throughput sweep (the reference's main.cpp N=1..999 loop):
+// for n in [1, n_max], count shapes and run the argmin; returns total
+// shapes visited.  Used to benchmark the native core.
+uint64_t ft_sweep(uint64_t n_max, double nbytes, double ici_bw, double ici_lat,
+                  double reduce_bw, double ctl_per_width, double launch_us) {
+  uint64_t total = 0;
+  uint32_t out[64];
+  double cost;
+  for (uint64_t n = 2; n <= n_max; ++n) {
+    total += ft_count_shapes(n);
+    ft_choose(n, nbytes, ici_bw, ici_lat, reduce_bw, ctl_per_width, launch_us,
+              out, 64, &cost);
+  }
+  return total;
+}
+
+}  // extern "C"

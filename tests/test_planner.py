@@ -1,0 +1,256 @@
+"""Planner tests: enumeration goldens (the reference README's N=7..10
+taxonomy, ``cost_model/README.md:13-71``), cost-model sanity, chooser
+behavior, and native-C++ vs Python parity."""
+
+import math
+
+import pytest
+
+from flextree_tpu.planner import (
+    TpuCostParams,
+    LinkParams,
+    allreduce_cost,
+    bus_bandwidth_GBps,
+    candidate_topologies,
+    choose_topology,
+    count_ordered_factorizations,
+    format_shape,
+    is_prime,
+    ordered_factorizations,
+    parse_shape,
+    prime_factors,
+    ring_cost,
+    shape_taxonomy,
+)
+from flextree_tpu.planner.native import (
+    native_available,
+    native_choose,
+    native_count_shapes,
+    native_enumerate_shapes,
+    native_shape_cost,
+)
+from flextree_tpu.schedule import Topology
+
+
+# ------------------------------------------------------------ factorize ----
+
+
+class TestFactorize:
+    def test_is_prime(self):
+        assert [n for n in range(20) if is_prime(n)] == [2, 3, 5, 7, 11, 13, 17, 19]
+        assert not is_prime(1)  # reference bug not replicated (IsPrimeNumber.h)
+
+    def test_prime_factors(self):
+        assert prime_factors(360) == [2, 2, 2, 3, 3, 5]
+        assert prime_factors(97) == [97]
+        assert prime_factors(1) == []
+
+    @pytest.mark.parametrize(
+        "n,expected",
+        [
+            # golden taxonomy, cost_model/README.md: N=8,9,10 worked examples
+            (8, {(8,), (2, 4), (4, 2), (2, 2, 2)}),
+            (9, {(9,), (3, 3)}),
+            (10, {(10,), (2, 5), (5, 2)}),
+            (6, {(6,), (2, 3), (3, 2)}),
+            (7, {(7,)}),  # prime: only the flat shape
+            (12, {(12,), (2, 6), (6, 2), (3, 4), (4, 3), (2, 2, 3), (2, 3, 2), (3, 2, 2)}),
+        ],
+    )
+    def test_ordered_factorizations_golden(self, n, expected):
+        assert set(ordered_factorizations(n)) == expected
+
+    def test_all_products_equal_n(self):
+        for n in range(2, 200):
+            for shape in ordered_factorizations(n):
+                assert math.prod(shape) == n
+                assert all(w >= 2 for w in shape)
+
+    def test_count_matches_enumeration(self):
+        for n in range(2, 300):
+            assert count_ordered_factorizations(n) == len(ordered_factorizations(n))
+
+    def test_count_edge(self):
+        assert count_ordered_factorizations(1) == 0
+        assert count_ordered_factorizations(2) == 1
+
+
+# --------------------------------------------------------------- shapes ----
+
+
+class TestShapes:
+    def test_format(self):
+        assert format_shape((2, 3)) == "2*3"
+        assert format_shape((2, 3), +1) == "2*3+1"
+        assert format_shape((2, 2, 2), -1) == "2*2*2-1"
+        assert format_shape((1,)) == "ring"
+
+    def test_parse_roundtrip(self):
+        for widths, delta in [((2, 3), 0), ((2, 3), 1), ((2, 2, 2), -1), ((1,), 0)]:
+            assert parse_shape(format_shape(widths, delta)) == (widths, delta)
+
+    def test_taxonomy_prime_uses_neighbors(self):
+        # N=7 (prime): shapes come from 6 (+1) and 8 (-1) — README.md:13-33
+        tax = shape_taxonomy(7)
+        assert "2*3+1" in tax and "3*2+1" in tax and "6+1" in tax
+        assert "2*4-1" in tax and "2*2*2-1" in tax and "8-1" in tax
+
+    def test_taxonomy_composite(self):
+        assert set(shape_taxonomy(9)) == {"9", "3*3"}
+
+
+# ----------------------------------------------------------- cost model ----
+
+
+class TestCostModel:
+    def test_bandwidth_term_is_shape_invariant(self):
+        """Telescoping: sum over stages of (w-1)/(g*w) == (N-1)/N, so on a
+        uniform fabric every factorization has the same bandwidth time."""
+        nbytes = 64 << 20
+        costs = [
+            allreduce_cost(Topology(16, w), nbytes).bandwidth_us
+            for w in [(16,), (4, 4), (2, 2, 2, 2), (2, 8)]
+        ]
+        assert max(costs) - min(costs) < 1e-6
+
+    def test_latency_prefers_fewer_hops(self):
+        nbytes = 1024  # tiny payload: latency-dominated
+        flat = allreduce_cost(Topology(16, (16,)), nbytes)
+        hd = allreduce_cost(Topology(16, (2, 2, 2, 2)), nbytes)
+        assert hd.latency_us < flat.latency_us
+
+    def test_ring_latency_heaviest(self):
+        nbytes = 1024
+        ring = ring_cost(16, nbytes)
+        hd = allreduce_cost(Topology(16, (2, 2, 2, 2)), nbytes)
+        assert ring.latency_us > hd.latency_us
+
+    def test_dcn_stage_costs_more(self):
+        t = Topology(32, (16, 2))
+        nbytes = 64 << 20
+        pure_ici = allreduce_cost(t, nbytes)
+        with_dcn = allreduce_cost(t, nbytes, dcn_stages=(1,))
+        assert with_dcn.total_us > pure_ici.total_us
+
+    def test_trivial_world(self):
+        assert ring_cost(1, 123).total_us == 0.0
+
+    def test_bus_bandwidth(self):
+        # 2*(N-1)/N * S / t ; 8 ranks, 1 GB, 10 ms -> 175 GB/s
+        bw = bus_bandwidth_GBps(8, 1e9, 10_000)
+        assert abs(bw - 175.0) < 1e-6
+        assert bus_bandwidth_GBps(8, 1e9, 0) == 0.0
+
+
+# -------------------------------------------------------------- chooser ----
+
+
+class TestChooser:
+    def test_candidates_include_ring_sentinel(self):
+        assert (1,) in candidate_topologies(8)
+
+    def test_plan_is_usable_topology(self):
+        plan = choose_topology(16, 64 << 20)
+        assert math.prod(plan.widths) == 16 or plan.widths == (1,)
+        assert plan.to_ft_topo()  # parsable by get_stages
+        from flextree_tpu.schedule import get_stages
+
+        assert get_stages(16, plan.to_ft_topo()) == plan.topology.widths
+
+    def test_large_payload_prefers_low_latency_tree(self):
+        # at huge payloads bandwidth dominates and all shapes tie; the
+        # chooser must still return a valid shape deterministically
+        plan = choose_topology(16, 1 << 30)
+        assert math.prod(plan.widths) == 16 or plan.widths == (1,)
+
+    def test_small_payload_prefers_fewer_stages_hops(self):
+        plan = choose_topology(16, 256)
+        # latency-dominated: halving-doubling-like shapes should beat flat
+        assert plan.widths != (16,)
+
+    def test_prime_n_advisory(self):
+        plan = choose_topology(13, 1 << 20)
+        assert plan.widths in ((13,), (1,))
+        assert len(plan.advisory) == 2
+        assert "12" in plan.advisory[0] and "14" in plan.advisory[1]
+
+    def test_torus_aligned_marking(self):
+        plan = choose_topology(256, 256 << 20, mesh_shape=(16, 16))
+        aligned = {c.widths for c in plan.candidates if c.torus_aligned}
+        assert (16, 16) in aligned
+        assert (4, 4, 4, 4) in aligned  # 4*4 tiles axis0, 4*4 tiles axis1
+        assert (2, 128) not in aligned  # 2*128 crosses the axis boundary
+
+    def test_mesh_with_dcn_axis(self):
+        # 2 slices of 16 chips: outer axis is DCN; aligned shapes pay DCN
+        # only on the stage riding the DCN axis, while misaligned shapes
+        # are priced all-DCN (pessimistic) and must not win
+        plan = choose_topology(32, 64 << 20, mesh_shape=(16, 2), dcn_axes=(1,))
+        # the winner must be a torus-aligned tree: misaligned trees and the
+        # ring are priced all-DCN, aligned trees pay DCN on one stage only
+        assert plan.candidates[0].torus_aligned
+        c_aligned = next(c for c in plan.candidates if c.widths == (16, 2))
+        c_flat = next(c for c in plan.candidates if c.widths == (32,))
+        c_ring = next(c for c in plan.candidates if c.widths == (1,))
+        assert c_flat.total_us > c_aligned.total_us
+        assert c_ring.total_us > plan.candidates[0].total_us
+
+    def test_degenerate_mesh_axis_ignored(self):
+        # a size-1 mesh axis must not mark every shape misaligned (which,
+        # with dcn_axes, would price correct trees at DCN)
+        plan = choose_topology(8, 64 << 20, mesh_shape=(8, 1), dcn_axes=(1,))
+        c8 = next(c for c in plan.candidates if c.widths == (8,))
+        assert c8.torus_aligned
+        no_mesh = choose_topology(8, 64 << 20)
+        c8_ref = next(c for c in no_mesh.candidates if c.widths == (8,))
+        assert abs(c8.total_us - c8_ref.total_us) < 1e-9
+
+    def test_n1(self):
+        plan = choose_topology(1, 100)
+        assert plan.topology.num_nodes == 1
+
+
+# --------------------------------------------------------------- native ----
+
+
+@pytest.mark.skipif(not native_available(), reason="native lib not built")
+class TestNative:
+    def test_count_parity(self):
+        for n in [2, 8, 12, 60, 97, 360, 720, 997]:
+            assert native_count_shapes(n) == count_ordered_factorizations(n)
+
+    def test_enumeration_parity(self):
+        for n in [2, 8, 12, 60, 97]:
+            assert set(native_enumerate_shapes(n)) == set(ordered_factorizations(n))
+
+    def test_cost_parity(self):
+        params = TpuCostParams()
+        for n, widths in [(16, (4, 4)), (16, (2, 2, 2, 2)), (8, (8,)), (8, (1,))]:
+            topo = Topology.ring(n) if widths == (1,) else Topology(n, widths)
+            py = (
+                ring_cost(n, 1 << 20, params)
+                if widths == (1,)
+                else allreduce_cost(topo, 1 << 20, params)
+            ).total_us
+            nat = native_shape_cost(widths, n, 1 << 20, params)
+            assert abs(py - nat) < 1e-9 * max(1.0, py), (n, widths)
+
+    def test_choose_parity(self):
+        params = TpuCostParams()
+        for n in [4, 8, 12, 16, 60, 64]:
+            for nbytes in [256, 1 << 20, 256 << 20]:
+                plan = choose_topology(n, nbytes, params)
+                widths, cost = native_choose(n, nbytes, params)
+                assert abs(cost - plan.candidates[0].total_us) < 1e-6 * max(1.0, cost)
+                # argmin may tie; require equal cost rather than equal shape
+                nat_topo = (
+                    Topology.ring(n) if widths == (1,) else Topology(n, widths)
+                )
+                nat_cost = (
+                    ring_cost(n, nbytes, params)
+                    if widths == (1,)
+                    else allreduce_cost(nat_topo, nbytes, params)
+                ).total_us
+                assert abs(nat_cost - plan.candidates[0].total_us) <= 1e-6 * max(
+                    1.0, nat_cost
+                )

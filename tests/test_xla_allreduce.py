@@ -142,6 +142,25 @@ def test_reduce_scatter_then_allgather_roundtrip(mesh):
     np.testing.assert_allclose(out, np.tile(data.sum(0), (8, 1)), rtol=1e-4)
 
 
+def test_separable_phases_non_divisible_count(mesh):
+    """reduce_scatter∘allgather must be a full allreduce even when count is
+    not divisible by N (padding sliced off, shape restored via out_shape)."""
+    data = RNG.standard_normal((8, 5, 7)).astype(np.float32)  # 35 elems
+    topo = Topology(8, (4, 2))
+
+    def f(row):
+        piece = reduce_scatter(row[0], "ft", topo)
+        return allgather(piece, "ft", topo, out_shape=row[0].shape)[None]
+
+    out = np.asarray(
+        jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft")))(
+            jnp.asarray(data)
+        )
+    )
+    assert out.shape == data.shape
+    np.testing.assert_allclose(out, np.tile(data.sum(0), (8, 1, 1)), rtol=1e-4)
+
+
 def test_reduce_scatter_tile_size(mesh):
     data = RNG.standard_normal((8, 40)).astype(np.float32)
 
