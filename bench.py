@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Driver benchmark entry point: prints ONE JSON line
+``{"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}``.
+
+Two modes, auto-selected:
+
+- **TPU attached** (the normal driver environment): benchmark the hot
+  compute path of the allreduce — the Pallas multi-source reduction kernel
+  (the rebuild of the reference's OpenMP ``reduce_sum``,
+  ``mpi_mod.hpp:246-452``) — against XLA's fused reduction of the same
+  stacked array.  Metric is achieved HBM bandwidth; ``vs_baseline`` is
+  ours/XLA.  (Only one TPU chip is attached, so the multi-chip allreduce
+  itself can't run on real hardware; its A/B lives in the CPU fallback and
+  in ``python -m flextree_tpu.bench``.)
+- **TPU unavailable / wedged**: the FlexTree allreduce vs ``lax.psum`` A/B
+  on an 8-virtual-device CPU mesh (the reference's ``--comm-type`` A/B,
+  ``benchmark.cpp:147-174``); metric is bus bandwidth, ``vs_baseline`` is
+  FlexTree/psum.
+
+The TPU probe runs in a subprocess with a timeout because a wedged axon
+tunnel hangs backend init indefinitely (observed in this container);
+``bench.py`` must never hang the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def tpu_alive(timeout_s: int = 120) -> bool:
+    if os.environ.get("FLEXTREE_BENCH_PLATFORM") == "cpu":
+        return False
+    code = (
+        "import jax\n"
+        "assert any(d.platform != 'cpu' for d in jax.devices())\n"
+        "print('tpu-ok')\n"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        return p.returncode == 0 and "tpu-ok" in p.stdout
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def bench_tpu_kernel() -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    from flextree_tpu.ops.pallas_reduce import reduce_stacked, reduce_stacked_reference
+    from flextree_tpu.utils.timing import time_jax_fn
+
+    w, length = 8, 4 * 1024 * 1024  # 8 sources x 16 MB float32
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((w, length)).astype(np.float32))
+
+    ours = time_jax_fn(
+        lambda v: reduce_stacked(v, op="sum", interpret=False), x, repeat=20
+    )
+    baseline = time_jax_fn(
+        jax.jit(lambda v: reduce_stacked_reference(v, "sum")), x, repeat=20
+    )
+    nbytes = (w + 1) * length * 4  # read w copies + write one
+    ours_bw = nbytes / ours.min_s / 1e9
+    base_bw = nbytes / baseline.min_s / 1e9
+    return {
+        "metric": "pallas_multisource_reduce_hbm_bw",
+        "value": round(ours_bw, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(ours_bw / base_bw, 3),
+    }
+
+
+def bench_cpu_allreduce() -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    import numpy as np
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    from flextree_tpu.bench.harness import BenchConfig, run_allreduce_bench
+    from flextree_tpu.planner import choose_topology
+
+    size = 1 << 20  # 4 MB float32 per rank
+    plan = choose_topology(8, size * 4)
+    # the planner's constants are TPU-calibrated; on the CPU fallback, rank
+    # a small candidate set empirically (the planner's top pick included)
+    candidates = {plan.to_ft_topo(), "8", "2,2,2", "4,2", "1"}
+    ours = None
+    for topo in sorted(candidates):
+        rep = run_allreduce_bench(
+            BenchConfig(size=size, repeat=10, comm_type="flextree", topo=topo)
+        )
+        if rep.correct and (ours is None or rep.bus_bw_GBps > ours.bus_bw_GBps):
+            ours = rep
+    base = run_allreduce_bench(BenchConfig(size=size, repeat=10, comm_type="xla"))
+    if ours is None or not base.correct:
+        raise RuntimeError("correctness check failed in bench")
+    return {
+        "metric": "allreduce_bus_bw_8vdev_cpu",
+        "value": round(ours.bus_bw_GBps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(ours.bus_bw_GBps / base.bus_bw_GBps, 3),
+    }
+
+
+def main() -> int:
+    try:
+        if tpu_alive():
+            result = bench_tpu_kernel()
+        else:
+            result = bench_cpu_allreduce()
+    except Exception as e:  # never hang or die silently: emit a valid line
+        result = {
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": f"error:{type(e).__name__}",
+            "vs_baseline": 0.0,
+        }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

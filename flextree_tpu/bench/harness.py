@@ -1,0 +1,157 @@
+"""Benchmark harness: A/B the FlexTree allreduce against the platform-native
+collective, mirroring the reference's standalone harness
+(``allreduce_over_mpi/benchmark.cpp``).
+
+Correspondence:
+- CLI flags ``--size --repeat --comm-type --to-file --tag``
+    -> ``benchmark.cpp:67-116`` (same names; ``--comm-type`` values are
+       ``flextree`` and ``xla`` — the latter standing in for the reference's
+       ``mpi`` library baseline, ``benchmark.cpp:161-174``);
+- per-rep timing with a completion gate -> ``benchmark.cpp:149-159``
+  (``block_until_ready`` instead of ``MPI_Barrier``+``MPI_Wtime``);
+- eyeball check of elements 9..19 plus a hard assert
+    -> ``benchmark.cpp:180-189`` (ours also asserts; theirs only printed);
+- config summary before the run -> ``benchmark.cpp:128-143``;
+- result files ``{tag}.{N}.{size}.{topo}.{ar|comm}_test.{time}.json``
+    -> ``benchmark.cpp:193-213``.
+
+Reported metric: per-chip algorithmic (bus) bandwidth ``2(N-1)/N * S / t``
+per BASELINE.md, plus min/avg wall time like ``benchmark.cpp:215``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import allreduce_over_mesh, flat_mesh
+from ..planner.cost_model import bus_bandwidth_GBps
+from ..schedule.stages import Topology
+from ..utils.logging import get_logger, result_file_name, write_result_file
+from ..utils.timing import BenchResult, time_jax_fn
+
+__all__ = ["BenchConfig", "BenchReport", "run_allreduce_bench"]
+
+log = get_logger("flextree.bench")
+
+
+@dataclass(frozen=True)
+class BenchConfig:
+    size: int = 35  # elements per chip (reference default, benchmark.cpp:36)
+    repeat: int = 10
+    comm_type: str = "flextree"  # flextree | xla
+    topo: str | None = None  # FT_TOPO-style spec; None -> env/flat
+    devices: int | None = None  # None -> all available
+    dtype: str = "float32"
+    op: str = "sum"
+    tag: str = "flextree"
+    to_file: bool = False
+    out_dir: str = "."
+
+
+@dataclass(frozen=True)
+class BenchReport:
+    config: BenchConfig
+    num_devices: int
+    topo: str
+    result: BenchResult
+    bus_bw_GBps: float
+    correct: bool
+    result_path: str | None = None
+
+    def payload(self) -> dict:
+        return {
+            "config": dataclasses.asdict(self.config),
+            "num_devices": self.num_devices,
+            "topo": self.topo,
+            "times_s": list(self.result.times_s),
+            "compile_s": self.result.compile_s,
+            "min_s": self.result.min_s,
+            "avg_s": self.result.avg_s,
+            "bus_bw_GBps": self.bus_bw_GBps,
+            "correct": self.correct,
+        }
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_psum(mesh, axis):
+    """Cached jitted lax.psum baseline — cached exactly like the flextree
+    path's ``_jitted_allreduce`` so the A/B times collectives, not retraces."""
+
+    def per_device(row):
+        return lax.psum(row[0], axis)[None]
+
+    return jax.jit(
+        jax.shard_map(per_device, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    )
+
+
+def _xla_psum_over_mesh(stacked, mesh, axis, op):
+    """The platform-native baseline (the reference's ``--comm-type mpi``)."""
+    if op != "sum":
+        raise ValueError("the xla baseline benchmarks psum; use op=sum")
+    return _jitted_psum(mesh, axis)(stacked)
+
+
+def run_allreduce_bench(cfg: BenchConfig) -> BenchReport:
+    n = cfg.devices or len(jax.devices())
+    mesh = flat_mesh(n, "ft")
+    topo = Topology.resolve(n, cfg.topo)
+    dtype = jnp.dtype(cfg.dtype)
+
+    # data[i] = i per rank, like benchmark.cpp:119-124 (in float32 the sums
+    # stay exactly representable for the sizes we assert on)
+    base = np.arange(cfg.size, dtype=np.float64) % 1024
+    data = np.tile(base, (n, 1)).astype(dtype)
+    stacked = jnp.asarray(data)
+
+    log.info(
+        "bench config: devices=%d size=%d dtype=%s op=%s comm=%s topo=%s repeat=%d",
+        n, cfg.size, cfg.dtype, cfg.op, cfg.comm_type, topo, cfg.repeat,
+    )
+
+    if cfg.comm_type == "flextree":
+        fn = lambda x: allreduce_over_mesh(x, mesh, topo=topo, op=cfg.op)
+    elif cfg.comm_type == "xla":
+        fn = lambda x: _xla_psum_over_mesh(x, mesh, "ft", cfg.op)
+    else:
+        raise ValueError(f"unknown --comm-type {cfg.comm_type!r} (flextree|xla)")
+
+    result = time_jax_fn(fn, stacked, repeat=cfg.repeat)
+
+    out = np.asarray(fn(stacked))
+    expect = (base * n).astype(np.float64)
+    got = out[0].astype(np.float64)
+    correct = bool(np.allclose(got, expect, rtol=1e-3, atol=1e-3))
+    lo, hi = 9, min(20, cfg.size)
+    if hi > lo:  # the reference's eyeball print of data[9..19]
+        log.info("elements %d..%d: %s (expect %s)", lo, hi - 1,
+                 got[lo:hi].tolist(), expect[lo:hi].tolist())
+
+    nbytes = cfg.size * dtype.itemsize
+    bus = bus_bandwidth_GBps(n, nbytes, result.min_s * 1e6)
+    log.info(
+        "average time %.3f ms / min time %.3f ms / bus bw %.3f GB/s / correct=%s",
+        result.avg_s * 1e3, result.min_s * 1e3, bus, correct,
+    )
+
+    path = None
+    if cfg.to_file:
+        name = result_file_name(
+            cfg.tag, n, cfg.size, str(topo), comm_test=(cfg.comm_type == "xla")
+        )
+        report = BenchReport(cfg, n, str(topo), result, bus, correct, None)
+        path = str(write_result_file(f"{cfg.out_dir}/{name}", report.payload()))
+        log.info("wrote %s", path)
+
+    return BenchReport(cfg, n, str(topo), result, bus, correct, path)

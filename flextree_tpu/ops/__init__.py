@@ -2,4 +2,21 @@
 
 from .reduce import ReduceOp, SUPPORTED_OPS, check_dtype, get_op
 
-__all__ = ["ReduceOp", "SUPPORTED_OPS", "check_dtype", "get_op"]
+__all__ = [
+    "ReduceOp",
+    "SUPPORTED_OPS",
+    "check_dtype",
+    "get_op",
+    "reduce_stacked",
+    "reduce_stacked_reference",
+]
+
+
+def __getattr__(name):
+    # Lazy: the Pallas kernel pulls in JAX; keep the base op registry
+    # importable without it (the schedule layer stays JAX-free).
+    if name in ("reduce_stacked", "reduce_stacked_reference"):
+        from . import pallas_reduce
+
+        return getattr(pallas_reduce, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

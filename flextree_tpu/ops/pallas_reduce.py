@@ -1,0 +1,98 @@
+"""Pallas TPU kernel: fold W source buffers into one, elementwise.
+
+The TPU rebuild of the reference's local reduction kernels ``reduce_sum`` /
+``reduce_band`` (``allreduce_over_mpi/mpi_mod.hpp:246-660``): there, an
+OpenMP ``parallel for simd`` over up to 20 sources with a hand-unrolled
+switch per source count; here, a single VPU kernel tiled over the payload,
+streaming ``(W, rows_tile, 128)`` blocks HBM->VMEM and writing the reduced
+``(rows_tile, 128)`` tile back.  XLA fuses this pattern well on its own —
+the kernel exists because the local reduce is the allreduce's only compute
+(SURVEY §3.2 "HOT LOOP") and a hand-tiled kernel both pins the layout and
+gives the benchmark a deterministic HBM-bandwidth probe on one chip.
+
+The op set mirrors the ``handle_reduce`` dispatch (``mpi_mod.hpp:825-874``):
+sum + the bitwise/lattice family, validated against the same dtype matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .reduce import get_op
+
+__all__ = ["reduce_stacked", "reduce_stacked_reference"]
+
+_LANE = 128
+
+
+def _kernel(x_ref, o_ref, *, w: int, jnp_name: str):
+    if jnp_name == "add":
+        # jnp.sum over the leading (source) axis vectorizes cleanly
+        o_ref[:] = jnp.sum(x_ref[:], axis=0)
+    else:
+        fn = getattr(jnp, jnp_name)
+        acc = x_ref[0]
+        for j in range(1, w):
+            acc = fn(acc, x_ref[j])
+        o_ref[:] = acc
+
+
+def reduce_stacked_reference(x: jax.Array, op="sum") -> jax.Array:
+    """Pure-jnp oracle: fold ``x[(W, L)]`` over axis 0 with ``op``."""
+    rop = get_op(op)
+    fn = getattr(jnp, rop.jnp_name)
+    acc = x[0]
+    for j in range(1, x.shape[0]):
+        acc = fn(acc, x[j])
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("op", "rows_tile", "interpret"))
+def reduce_stacked(
+    x: jax.Array,
+    op: str = "sum",
+    rows_tile: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Reduce ``x`` of shape ``(W, L)`` over axis 0 -> ``(L,)`` on the VPU.
+
+    ``L`` is padded internally to a multiple of ``rows_tile * 128`` with the
+    op identity (like the schedule layer pads to ``data_size_aligned``,
+    ``mpi_mod.hpp:232``).  ``interpret=None`` auto-selects the Pallas
+    interpreter off-TPU so tests run on CPU.
+    """
+    from jax.experimental import pallas as pl
+
+    rop = get_op(op)
+    rop.check_dtype(x.dtype)
+    if x.ndim != 2:
+        raise ValueError(f"expected (num_sources, length), got {x.shape}")
+    w, length = x.shape
+    if w == 1:
+        return x[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    chunk = rows_tile * _LANE
+    padded = -(-length // chunk) * chunk
+    if padded != length:
+        pad_val = rop.identity_for(x.dtype)
+        x = jnp.pad(x, ((0, 0), (0, padded - length)), constant_values=pad_val)
+    rows = padded // _LANE
+    x3 = x.reshape(w, rows, _LANE)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, w=w, jnp_name=rop.jnp_name),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), x.dtype),
+        grid=(rows // rows_tile,),
+        in_specs=[
+            pl.BlockSpec((w, rows_tile, _LANE), lambda i: (0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows_tile, _LANE), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x3)
+    return out.reshape(padded)[:length]

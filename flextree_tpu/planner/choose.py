@@ -21,7 +21,8 @@ prefers those when a mesh shape is provided.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import math
+from dataclasses import dataclass
 
 from ..schedule.stages import Topology
 from .cost_model import CostBreakdown, TpuCostParams, allreduce_cost
@@ -77,26 +78,33 @@ class Plan:
         return "\n".join(lines)
 
 
-def _is_torus_aligned(widths: tuple[int, ...], mesh_shape: tuple[int, ...]) -> bool:
-    """True if ``widths`` tiles ``mesh_shape`` axis by axis, in order: each
-    mesh axis is covered by a contiguous run of widths whose product equals
-    the axis size (so every stage's groups span exactly one physical axis).
-    Degenerate size-1 axes are ignored (no width can consume them)."""
-    mesh_shape = tuple(s for s in mesh_shape if s > 1)
-    if not mesh_shape:
-        return False
+def _stage_axes(
+    widths: tuple[int, ...], mesh_shape: tuple[int, ...]
+) -> tuple[int, ...] | None:
+    """Map each stage to the mesh axis its groups ride, or None if the
+    widths don't tile ``mesh_shape`` axis by axis in order.
+
+    Aligned means: each mesh axis is covered by a contiguous run of widths
+    whose product equals the axis size (so every stage's groups span exactly
+    one physical axis).  The per-stage axis indices are returned so DCN
+    stages can be identified by the same traversal that decides alignment.
+    """
     ai = 0
     acc = 1
+    axes: list[int] = []
     for w in widths:
         if ai >= len(mesh_shape):
-            return False
+            return None
+        axes.append(ai)
         acc *= w
         if acc == mesh_shape[ai]:
             ai += 1
             acc = 1
         elif mesh_shape[ai] % acc != 0:
-            return False
-    return ai == len(mesh_shape) and acc == 1
+            return None
+    if ai == len(mesh_shape) and acc == 1:
+        return tuple(axes)
+    return None
 
 
 def candidate_topologies(n: int) -> list[tuple[int, ...]]:
@@ -126,7 +134,14 @@ def choose_topology(
     """
     if n < 1:
         raise ValueError(f"n must be >= 1, got {n}")
+    if dcn_axes and not mesh_shape:
+        raise ValueError("dcn_axes requires mesh_shape (which axes are DCN?)")
     if mesh_shape:
+        if math.prod(mesh_shape) != n:
+            raise ValueError(
+                f"mesh_shape {mesh_shape} has {math.prod(mesh_shape)} devices, "
+                f"but n is {n}"
+            )
         # drop degenerate size-1 axes, remapping dcn_axes indices to match
         keep = [i for i, s in enumerate(mesh_shape) if s > 1]
         dcn_axes = tuple(keep.index(a) for a in dcn_axes if a in keep)
@@ -144,23 +159,14 @@ def choose_topology(
             cands.append(Candidate((1,), cost, False))
             continue
         topo = Topology(n, widths)
-        aligned = _is_torus_aligned(widths, mesh_shape) if mesh_shape else False
+        stage_axes = _stage_axes(widths, mesh_shape) if mesh_shape else None
+        aligned = stage_axes is not None
         dcn_stages: tuple[int, ...] = ()
-        if dcn_axes and mesh_shape and widths != (1,):
+        if dcn_axes:
             if aligned:
-                # map each stage to its mesh axis; stages landing on DCN
-                # axes pay DCN constants
-                stage_axis = []
-                ai = 0
-                acc = 1
-                for w in widths:
-                    stage_axis.append(ai)
-                    acc *= w
-                    if acc == mesh_shape[ai]:
-                        ai += 1
-                        acc = 1
+                # stages whose mesh axis is DCN pay DCN constants
                 dcn_stages = tuple(
-                    i for i, a in enumerate(stage_axis) if a in set(dcn_axes)
+                    i for i, a in enumerate(stage_axes) if a in set(dcn_axes)
                 )
             else:
                 # a shape that doesn't tile the torus axes has groups

@@ -27,8 +27,7 @@ All times in microseconds, sizes in bytes.
 
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..schedule.stages import Topology
 

@@ -1,0 +1,57 @@
+"""Logging + result-file conventions.
+
+The reference uses glog with rank-0 gating (``LOG_IF(INFO, rank == 0)``)
+and writes per-run timing files named
+``{tag}.{N}.{size}.{topo}.{ar_test|comm_test}.{unix_time}.txt``
+(``benchmark.cpp:193-213``).  We keep the same file-name scheme (so tooling
+built for the reference's outputs keeps working) but write JSON payloads,
+and use stdlib logging with an explicit process-0 gate instead of glog.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+
+__all__ = ["get_logger", "log_if_rank0", "result_file_name", "write_result_file"]
+
+_FMT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
+
+
+def get_logger(name: str = "flextree") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(h)
+        logger.setLevel(os.environ.get("FT_LOG_LEVEL", "INFO"))
+        logger.propagate = False
+    return logger
+
+
+def log_if_rank0(logger: logging.Logger, msg: str, *args, rank: int = 0) -> None:
+    """The ``LOG_IF(INFO, total_peers == 0)`` pattern of the reference
+    benchmark (``benchmark.cpp:128-143``): only process/rank 0 speaks."""
+    if rank == 0:
+        logger.info(msg, *args)
+
+
+def result_file_name(
+    tag: str, num_devices: int, size: int, topo: str, comm_test: bool = False
+) -> str:
+    """``{tag}.{N}.{size}.{topo}.{ar_test|comm_test}.{unix_time}.json`` —
+    the reference's scheme (``benchmark.cpp:196-200``) with a json suffix."""
+    kind = "comm_test" if comm_test else "ar_test"
+    topo_s = topo.replace(",", "-").replace("*", "-") or "flat"
+    return f"{tag}.{num_devices}.{size}.{topo_s}.{kind}.{int(time.time())}.json"
+
+
+def write_result_file(path: str | Path, payload: dict) -> Path:
+    """Write one benchmark result as pretty JSON; returns the path."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    return p

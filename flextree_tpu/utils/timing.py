@@ -1,0 +1,98 @@
+"""Timing utilities: a chrono-style stopwatch and a device-aware benchmark
+loop.
+
+The stopwatch mirrors the reference planner's ``newplan::Timer``
+(``cost_model/timer.h:15-130``: Start/Stop/elapsed in s/ms/µs/ns).  The
+benchmark loop is the analog of the reference harness's barrier+MPI_Wtime
+pattern (``benchmark.cpp:149-174``) done right for an async dispatch model:
+``block_until_ready`` gates both the warmup and every timed repetition (the
+reference relied on the collective being blocking — SURVEY §8 notes the
+missing completion gate).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+__all__ = ["Timer", "BenchResult", "time_jax_fn"]
+
+
+class Timer:
+    """Minimal stopwatch: ``Timer()`` starts it; ``elapsed_*`` reads it."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._stopped: float | None = None
+
+    def restart(self) -> None:
+        self._t0 = time.perf_counter()
+        self._stopped = None
+
+    def stop(self) -> float:
+        self._stopped = time.perf_counter()
+        return self._stopped - self._t0
+
+    @property
+    def elapsed_s(self) -> float:
+        end = self._stopped if self._stopped is not None else time.perf_counter()
+        return end - self._t0
+
+    @property
+    def elapsed_ms(self) -> float:
+        return self.elapsed_s * 1e3
+
+    @property
+    def elapsed_us(self) -> float:
+        return self.elapsed_s * 1e6
+
+    @property
+    def elapsed_ns(self) -> float:
+        return self.elapsed_s * 1e9
+
+
+@dataclass(frozen=True)
+class BenchResult:
+    """Per-repetition wall times plus the min/avg summary the reference
+    harness logs (``benchmark.cpp:215``)."""
+
+    times_s: tuple[float, ...]
+    compile_s: float
+
+    @property
+    def min_s(self) -> float:
+        return min(self.times_s)
+
+    @property
+    def avg_s(self) -> float:
+        return sum(self.times_s) / len(self.times_s)
+
+    @property
+    def median_s(self) -> float:
+        ts = sorted(self.times_s)
+        n = len(ts)
+        mid = n // 2
+        return ts[mid] if n % 2 else 0.5 * (ts[mid - 1] + ts[mid])
+
+
+def time_jax_fn(fn, *args, repeat: int = 10, warmup: int = 2) -> BenchResult:
+    """Time ``fn(*args)`` with compile excluded and every rep fully gated.
+
+    The first call (compile + run) is timed separately; ``warmup`` extra
+    calls absorb autotuning; then ``repeat`` reps are timed individually
+    with ``jax.block_until_ready`` inside the timed region (the
+    ``MPI_Barrier``/``MPI_Wtime`` analog of ``benchmark.cpp:151-157``).
+    """
+    t = Timer()
+    jax.block_until_ready(fn(*args))
+    compile_s = t.stop()
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(repeat):
+        t.restart()
+        jax.block_until_ready(fn(*args))
+        times.append(t.stop())
+    return BenchResult(tuple(times), compile_s)

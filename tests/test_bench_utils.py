@@ -1,0 +1,165 @@
+"""Tests for the benchmark harness, timing/logging utils, the Pallas
+reduction kernel, and the bench.py driver contract."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flextree_tpu.bench import BenchConfig, run_allreduce_bench
+from flextree_tpu.ops import reduce_stacked, reduce_stacked_reference, SUPPORTED_OPS
+from flextree_tpu.utils import (
+    BenchResult,
+    Timer,
+    result_file_name,
+    time_jax_fn,
+    write_result_file,
+)
+
+RNG = np.random.default_rng(0)
+
+
+class TestTimer:
+    def test_elapsed_monotone(self):
+        t = Timer()
+        a = t.elapsed_s
+        b = t.elapsed_s
+        assert b >= a >= 0
+        assert t.elapsed_ms == pytest.approx(t.elapsed_s * 1e3, rel=0.5)
+
+    def test_stop_freezes(self):
+        t = Timer()
+        s = t.stop()
+        assert t.elapsed_s == s
+
+    def test_restart(self):
+        t = Timer()
+        t.stop()
+        t.restart()
+        assert t.elapsed_s < 1.0
+
+
+class TestTimeJaxFn:
+    def test_basic(self):
+        f = jax.jit(lambda x: x * 2 + 1)
+        r = time_jax_fn(f, jnp.ones(16), repeat=3, warmup=1)
+        assert len(r.times_s) == 3
+        assert r.min_s <= r.avg_s
+        assert r.compile_s > 0
+        assert r.median_s >= r.min_s
+
+
+class TestBenchResult:
+    def test_stats(self):
+        r = BenchResult((3.0, 1.0, 2.0), 0.1)
+        assert r.min_s == 1.0 and r.avg_s == 2.0 and r.median_s == 2.0
+
+
+class TestResultFiles:
+    def test_name_scheme(self):
+        name = result_file_name("tag", 8, 100, "4,2")
+        parts = name.split(".")
+        assert parts[0] == "tag" and parts[1] == "8" and parts[2] == "100"
+        assert parts[3] == "4-2" and parts[4] == "ar_test"
+        assert result_file_name("t", 8, 1, "4*2").split(".")[3] == "4-2"
+        assert result_file_name("t", 8, 1, "", comm_test=True).split(".")[3:5] == [
+            "flat",
+            "comm_test",
+        ]
+
+    def test_write(self, tmp_path):
+        p = write_result_file(tmp_path / "x.json", {"a": 1})
+        assert json.loads(p.read_text()) == {"a": 1}
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestHarness:
+    def test_flextree_run(self, tmp_path):
+        cfg = BenchConfig(
+            size=1000, repeat=2, topo="4,2", to_file=True, out_dir=str(tmp_path)
+        )
+        rep = run_allreduce_bench(cfg)
+        assert rep.correct
+        assert rep.bus_bw_GBps > 0
+        assert rep.result_path and json.loads(open(rep.result_path).read())["correct"]
+
+    def test_xla_baseline_run(self):
+        rep = run_allreduce_bench(BenchConfig(size=1000, repeat=2, comm_type="xla"))
+        assert rep.correct
+
+    def test_ring_run(self):
+        rep = run_allreduce_bench(BenchConfig(size=1000, repeat=2, topo="1"))
+        assert rep.correct
+
+    def test_bad_comm_type(self):
+        with pytest.raises(ValueError):
+            run_allreduce_bench(BenchConfig(comm_type="mpi"))
+
+    def test_baseline_jit_is_cached(self):
+        """The A/B is only fair if the psum baseline doesn't retrace per
+        call (regression: fresh jit wrapper per invocation)."""
+        from flextree_tpu.bench.harness import _jitted_psum
+        from flextree_tpu.parallel import flat_mesh
+
+        mesh = flat_mesh(8, "ft")
+        assert _jitted_psum(mesh, "ft") is _jitted_psum(mesh, "ft")
+
+
+class TestPallasReduce:
+    @pytest.mark.parametrize("opname", ["sum", "band", "max", "min", "bor"])
+    def test_matches_reference(self, opname):
+        w, L = 5, 3000
+        if opname in ("band", "bor"):
+            x = RNG.integers(0, 2**20, (w, L)).astype(np.int32)
+        else:
+            x = RNG.standard_normal((w, L)).astype(np.float32)
+        got = np.asarray(reduce_stacked(jnp.asarray(x), op=opname))
+        want = np.asarray(reduce_stacked_reference(jnp.asarray(x), op=opname))
+        if x.dtype == np.float32:
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+        else:
+            np.testing.assert_array_equal(got, want)
+
+    def test_single_source_passthrough(self):
+        x = RNG.standard_normal((1, 100)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(reduce_stacked(jnp.asarray(x))), x[0])
+
+    def test_large_and_unaligned(self):
+        # not a multiple of 128: exercises identity padding
+        x = RNG.standard_normal((3, 128 * 513 + 7)).astype(np.float32)
+        got = np.asarray(reduce_stacked(jnp.asarray(x)))
+        np.testing.assert_allclose(got, x.sum(0), rtol=1e-5)
+
+    def test_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            reduce_stacked(jnp.ones((2, 3, 4)))
+
+    def test_rejects_bad_dtype_op(self):
+        with pytest.raises(TypeError):
+            reduce_stacked(jnp.ones((2, 8), jnp.float32), op="band")
+
+
+class TestBenchPyContract:
+    def test_one_json_line(self):
+        """bench.py must print exactly one JSON line with the driver's keys
+        (forced to the CPU path so it never touches the TPU tunnel)."""
+        env = {"FLEXTREE_BENCH_PLATFORM": "cpu", "PATH": "/usr/bin:/bin"}
+        p = subprocess.run(
+            [sys.executable, "/root/repo/bench.py"],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+        )
+        assert p.returncode == 0, p.stderr[-500:]
+        lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+        assert len(lines) == 1, p.stdout
+        payload = json.loads(lines[0])
+        assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+        assert payload["metric"] != "bench_error", payload
+        assert payload["value"] > 0
