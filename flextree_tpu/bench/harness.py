@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..ops.reduce import get_op
 from ..parallel.mesh import allreduce_over_mesh, flat_mesh
 from ..planner.cost_model import bus_bandwidth_GBps
 from ..schedule.stages import Topology
@@ -108,12 +109,23 @@ def run_allreduce_bench(cfg: BenchConfig) -> BenchReport:
     mesh = flat_mesh(n, "ft")
     topo = Topology.resolve(n, cfg.topo)
     dtype = jnp.dtype(cfg.dtype)
+    rop = get_op(cfg.op)
+    rop.check_dtype(dtype)
 
-    # data[i] = i per rank, like benchmark.cpp:119-124 (in float32 the sums
-    # stay exactly representable for the sizes we assert on)
-    base = np.arange(cfg.size, dtype=np.float64) % 1024
-    data = np.tile(base, (n, 1)).astype(dtype)
+    # data[r, i] = (i % 256) + r, like benchmark.cpp:119-124 but with
+    # per-rank-distinct rows so every op has a non-trivial reduction; values
+    # are small so float32 sums stay exactly representable and integer
+    # wraparound (int8 etc.) is identical on host and device
+    base = np.arange(cfg.size, dtype=np.int64) % 256
+    data = (base[None, :] + np.arange(n, dtype=np.int64)[:, None]).astype(dtype)
     stacked = jnp.asarray(data)
+    if stacked.dtype != dtype:
+        # e.g. float64 demoted to float32 when jax_enable_x64 is off; keep
+        # the host copy consistent so the correctness check and byte counts
+        # describe what actually ran
+        log.warning("dtype %s demoted to %s on device", dtype, stacked.dtype)
+        dtype = stacked.dtype
+        data = data.astype(dtype)
 
     log.info(
         "bench config: devices=%d size=%d dtype=%s op=%s comm=%s topo=%s repeat=%d",
@@ -130,15 +142,28 @@ def run_allreduce_bench(cfg: BenchConfig) -> BenchReport:
     result = time_jax_fn(fn, stacked, repeat=cfg.repeat)
 
     out = np.asarray(fn(stacked))
-    expect = (base * n).astype(np.float64)
-    got = out[0].astype(np.float64)
-    correct = bool(np.allclose(got, expect, rtol=1e-3, atol=1e-3))
+    # fold the op over the host rows in the on-device dtype: integer
+    # wraparound then matches the device exactly; floats are compared with
+    # tolerance since the collective may reassociate the sum
+    expect = data[0]
+    for r in range(1, n):
+        expect = rop.np_fn(expect, data[r])
+    got = out[0]
+    if np.issubdtype(dtype, np.inexact) or dtype == jnp.bfloat16:
+        correct = bool(
+            np.allclose(
+                got.astype(np.float64), expect.astype(np.float64),
+                rtol=1e-3, atol=1e-3,
+            )
+        )
+    else:
+        correct = bool(np.array_equal(got, expect))
     lo, hi = 9, min(20, cfg.size)
     if hi > lo:  # the reference's eyeball print of data[9..19]
         log.info("elements %d..%d: %s (expect %s)", lo, hi - 1,
                  got[lo:hi].tolist(), expect[lo:hi].tolist())
 
-    nbytes = cfg.size * dtype.itemsize
+    nbytes = cfg.size * stacked.dtype.itemsize
     bus = bus_bandwidth_GBps(n, nbytes, result.min_s * 1e6)
     log.info(
         "average time %.3f ms / min time %.3f ms / bus bw %.3f GB/s / correct=%s",

@@ -1,0 +1,212 @@
+"""Flagship model: a decoder-only transformer LM, sharded TPU-first.
+
+The reference repo is a collectives library with no model layer (SURVEY
+§2.6); this model is the framework's demonstration workload — the thing the
+hierarchical allreduce, ring attention, and planner exist to serve.  Design
+is MXU-friendly and mesh-native:
+
+- **Tensor parallelism** over the ``tp`` mesh axis: QKV and MLP-up are
+  column-parallel (each shard owns a contiguous slice of heads / hidden
+  units), attention-out and MLP-down are row-parallel; the row-parallel
+  partial sums are combined with the framework's own topology-parameterized
+  ``flextree_tpu.parallel.allreduce`` — our collective is the TP backend,
+  the moral equivalent of the reference interposing its allreduce under a
+  host framework (``mpi_mod.hpp:1167-1171``).
+- **Sequence parallelism** over the ``sp`` mesh axis via
+  ``ring_attention`` (K/V blocks walk the ring, flash-style accumulation).
+- **RoPE** positions (global offsets derived from the ``sp`` axis index),
+  RMSNorm, GELU MLP, tied input/output embeddings — no learned position
+  table, so sequence length is bounded only by memory.
+- Pure functional: params are a plain dict pytree; ``forward`` works both
+  as an ordinary single-device function (no axes bound) and as a
+  collective-context function inside ``shard_map``.
+
+All matmuls keep a (tokens, features) trailing structure with static shapes
+so XLA tiles them onto the MXU; compute dtype is configurable (bfloat16 for
+TPU), accumulation and softmax stay float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.allreduce import allreduce
+from ..parallel.ring_attention import attention_reference, ring_attention
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "param_specs",
+    "forward",
+    "cross_entropy_loss",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 512
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.float32  # compute dtype; params stay float32
+    # topology spec for the TP-combining allreduce (None -> FT_TOPO/flat)
+    tp_topo: Any = None
+
+    @property
+    def head_dim(self) -> int:
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+        return self.d_model // self.n_heads
+
+
+def _dense_init(key, shape, scale):
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
+
+
+def init_params(key, cfg: TransformerConfig) -> dict:
+    """Full (unsharded) parameter pytree; shard_map in_specs slice it."""
+    d, ff = cfg.d_model, cfg.d_ff
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    params = {
+        "embed": _dense_init(keys[0], (cfg.vocab_size, d), 1.0 / math.sqrt(d)),
+        "ln_f": jnp.ones((d,), jnp.float32),
+        "layers": [],
+    }
+    out_scale = 1.0 / math.sqrt(d * 2 * cfg.n_layers)
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 6)
+        params["layers"].append(
+            {
+                "ln1": jnp.ones((d,), jnp.float32),
+                "wq": _dense_init(k[0], (d, d), 1.0 / math.sqrt(d)),
+                "wk": _dense_init(k[1], (d, d), 1.0 / math.sqrt(d)),
+                "wv": _dense_init(k[2], (d, d), 1.0 / math.sqrt(d)),
+                "wo": _dense_init(k[3], (d, d), out_scale),
+                "ln2": jnp.ones((d,), jnp.float32),
+                "w1": _dense_init(k[4], (d, ff), 1.0 / math.sqrt(d)),
+                "w2": _dense_init(k[5], (ff, d), out_scale),
+            }
+        )
+    return params
+
+
+def param_specs(cfg: TransformerConfig, tp_axis: str | None = "tp") -> dict:
+    """PartitionSpec pytree matching ``init_params`` structure.
+
+    Column-parallel weights shard their output dim over ``tp_axis``,
+    row-parallel weights their input dim; everything else is replicated.
+    """
+    t = tp_axis
+    layer = {
+        "ln1": P(None),
+        "wq": P(None, t),
+        "wk": P(None, t),
+        "wv": P(None, t),
+        "wo": P(t, None),
+        "ln2": P(None),
+        "w1": P(None, t),
+        "w2": P(t, None),
+    }
+    return {
+        "embed": P(None, None),
+        "ln_f": P(None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return ((x32 / rms) * scale).astype(x.dtype)
+
+
+def apply_rope(x, positions, theta: float):
+    """Rotary embedding on (B, T, H, Dh) with global ``positions`` (T,)."""
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+def _tp_combine(partial, tp_axis, cfg: TransformerConfig):
+    """Sum row-parallel partials across TP shards with *our* allreduce."""
+    if tp_axis is None:
+        return partial
+    return allreduce(partial, tp_axis, topo=cfg.tp_topo, op="sum")
+
+
+def forward(
+    params,
+    tokens,
+    cfg: TransformerConfig,
+    *,
+    tp_axis: str | None = None,
+    sp_axis: str | None = None,
+):
+    """Logits for ``tokens`` (B, T_local) int32.
+
+    With no axes bound this is a plain single-device forward.  Inside
+    ``shard_map``: batch may be sharded over a data axis (invisible here),
+    sequence over ``sp_axis``, and heads/hidden over ``tp_axis`` (params
+    pre-sliced by ``param_specs``).  Returns (B, T_local, vocab) logits in
+    float32, replicated over ``tp_axis``.
+    """
+    b, t_local = tokens.shape
+    if sp_axis is not None:
+        offset = lax.axis_index(sp_axis) * t_local
+    else:
+        offset = 0
+    positions = offset + jnp.arange(t_local)
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+    head_dim = cfg.head_dim
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["ln1"])
+        q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
+        k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
+        v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        if sp_axis is not None:
+            attn = ring_attention(q, k, v, sp_axis, causal=True)
+        else:
+            attn = attention_reference(q, k, v, causal=True)
+        o = attn.reshape(b, t_local, -1) @ layer["wo"].astype(cfg.dtype)
+        x = x + _tp_combine(o, tp_axis, cfg)
+
+        h = rms_norm(x, layer["ln2"])
+        u = jax.nn.gelu(h @ layer["w1"].astype(cfg.dtype))
+        y = u @ layer["w2"].astype(cfg.dtype)
+        x = x + _tp_combine(y, tp_axis, cfg)
+
+    x = rms_norm(x, params["ln_f"])
+    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return logits
+
+
+def cross_entropy_loss(logits, targets):
+    """Per-token cross entropy, summed — (loss_sum, token_count).
+
+    Summed (not meaned) so callers can normalize by a *global* token count
+    psum'd over the mesh, which keeps gradients exact under dp/sp sharding.
+    """
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    loss = (logz - gold).sum()
+    count = jnp.asarray(targets.size, jnp.float32)
+    return loss, count

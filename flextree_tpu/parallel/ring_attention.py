@@ -1,0 +1,147 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support built on the same ICI neighbor-exchange primitive as the
+ring allreduce (``ring_allreduce``, this package): the sequence is sharded
+over the ``sp`` mesh axis, queries stay put, and the K/V block walks the ring
+via ``lax.ppermute`` — one neighbor hop per step, exactly the communication
+pattern of the reference's ring reduce-scatter block walk
+(``allreduce_over_mpi/mpi_mod.hpp:1119-1147``), but carrying K/V tiles instead
+of gradient blocks.  Attention over the rotating blocks is accumulated with a
+numerically-stable online softmax (flash-attention style running max /
+normalizer), so the full ``T x T`` score matrix never materializes and the
+per-device memory is O(T/n * T/n) per step.
+
+The reference repo has no model layer; this module is part of the framework's
+model substrate that the hierarchical-collective layer (SURVEY §2.6) exists
+to serve.  Everything here is a *collective-context* function: call inside
+``shard_map`` with the sequence axis bound, like ``lax.psum``.
+
+Differentiable: the loop is a ``lax.scan`` of ``ppermute`` + elementwise math,
+all of which have exact transposes, so ``jax.grad`` through ring attention
+yields the true global gradient (cross-shard K/V contributions flow back
+through the permute transpose).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "attention_reference", "local_attention_block"]
+
+_NEG_INF = -1e30
+
+
+def local_attention_block(q, k, v, q_pos, k_pos, *, causal: bool, scale: float,
+                          m, l, acc):
+    """One online-softmax accumulation step over a single K/V block.
+
+    ``q``: (B, Tq, H, D); ``k``/``v``: (B, Tk, H, D); ``q_pos``/(Tq,) and
+    ``k_pos``/(Tk,) are *global* token positions for causal masking.
+    ``m``/(B, H, Tq) running max, ``l``/(B, H, Tq) running normalizer,
+    ``acc``/(B, Tq, H, D) running weighted-value sum.  Returns updated
+    ``(m, l, acc)``.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]  # (Tq, Tk)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # mask the *probabilities*, not just the scores: for a fully-masked row
+    # m_new stays at the -inf sentinel and exp(s - m_new) would be 1, not 0.
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name, *, causal: bool = True,
+                   scale: float | None = None):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    ``q``/``k``/``v``: (B, T_local, H, D) — this device's sequence shard; the
+    global sequence is the concatenation over the axis in index order.
+    Returns (B, T_local, H, D) attention output for the local queries, in
+    ``q``'s dtype.
+
+    Each of the ``n`` steps computes one (local-Q x visiting-KV) block and
+    rotates K/V one hop to the right neighbor — ``(j, (j+1) % n)`` — so at
+    step ``s`` device ``i`` holds the block originating at ``(i - s) mod n``
+    (the decrementing source walk of the reference ring,
+    ``mpi_mod.hpp:1145-1146``).  Causality is enforced with global positions,
+    so blocks strictly in the future contribute nothing (they still traverse
+    the ring: uniform steps keep the program SPMD and the schedule static).
+    """
+    n = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    idx = lax.axis_index(axis_name)
+    q_pos = idx * t_local + jnp.arange(t_local)
+
+    # derive the accumulators from q so they inherit q's varying mesh axes
+    # (q may vary over sp AND tp when heads are tensor-parallel): a fresh
+    # constant would be typed as replicated and fail the scan-carry check.
+    zero_bht = (q[..., 0] * 0).astype(jnp.float32).transpose(0, 2, 1)
+    m0 = zero_bht + _NEG_INF
+    l0 = zero_bht
+    acc0 = (q * 0).astype(jnp.float32)
+
+    if n == 1:
+        m, l, acc = m0, l0, acc0
+        m, l, acc = local_attention_block(
+            q, k, v, q_pos, q_pos, causal=causal, scale=scale, m=m, l=l, acc=acc
+        )
+        return _finalize(acc, l).astype(q.dtype)
+
+    right = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - s) % n
+        k_pos = src * t_local + jnp.arange(t_local)
+        m, l, acc = local_attention_block(
+            q, k_blk, v_blk, q_pos, k_pos, causal=causal, scale=scale,
+            m=m, l=l, acc=acc,
+        )
+        k_blk = lax.ppermute(k_blk, axis_name, right)
+        v_blk = lax.ppermute(v_blk, axis_name, right)
+        return (k_blk, v_blk, m, l, acc), None
+
+    init = (k, v, m0, l0, acc0)
+    (k, v, m, l, acc), _ = lax.scan(step, init, jnp.arange(n))
+    return _finalize(acc, l).astype(q.dtype)
+
+
+def _finalize(acc, l):
+    """Divide the weighted-value sum by the normalizer; fully-masked rows
+    (possible only for non-causal edge cases) yield zeros, not NaNs."""
+    denom = l.transpose(0, 2, 1)[..., None]
+    return jnp.where(denom > 0, acc / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def attention_reference(q, k, v, *, causal: bool = True,
+                        scale: float | None = None):
+    """Single-device full-matrix attention — the oracle for ring attention.
+
+    Same semantics on unsharded (B, T, H, D) inputs; used by the tests the
+    way ``--comm-type mpi`` served as the reference's A/B oracle
+    (``benchmark.cpp:147-174``).
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        pos = jnp.arange(t)
+        mask = pos[:, None] >= pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,248 @@
+"""Sharded training step: dp x sp x tp over one mesh, FlexTree grad sync.
+
+This is the framework's end-to-end composition — the role the reference
+plays inside a host framework when its allreduce interposes on the data-
+parallel gradient sync (``mpi_mod.hpp:1167-1171``): here the gradient
+allreduce *is* our topology-parameterized collective, and it also provides
+the TP partial-sum combine inside the model forward.
+
+Parallelism layout (one ``shard_map`` over a 3-axis mesh):
+
+- ``dp``  — batch dimension; no collective in the forward, gradients are
+  summed across it explicitly (the classic gradient allreduce).
+- ``sp``  — sequence dimension; ring attention moves K/V around the ring
+  in the forward, and its transpose carries the cross-shard gradient
+  contributions back automatically.
+- ``tp``  — heads / hidden units; column/row-parallel matmuls with the
+  row-parallel partials combined by ``flextree_tpu.parallel.allreduce``.
+
+Gradient-sync rule: automatic differentiation of the per-device loss gives,
+on every device, the gradient of the *sum of all devices' losses* with
+respect to that device's local parameter copy (collective transposes carry
+the cross-device terms).  The true gradient of a logically-shared parameter
+is the sum over its distinct copies — so each gradient leaf is explicitly
+allreduced over exactly the axes its parameter is *replicated* on: tp-
+sharded weights sync over (dp, sp); replicated ones over (dp, sp, tp).  The
+per-device loss is normalized by the global token count *including* the
+tp-fold redundancy, which makes the total differentiated quantity the true
+global mean loss.
+
+Optimizer is an inline AdamW (decoupled weight decay); its moments shard
+exactly like the parameters, so optimizer memory scales down with TP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer import (
+    TransformerConfig,
+    cross_entropy_loss,
+    forward,
+    init_params,
+    param_specs,
+)
+from ..schedule.stages import Topology, TopologyError
+from .allreduce import allreduce
+
+__all__ = [
+    "TrainConfig",
+    "init_train_state",
+    "state_specs",
+    "make_train_step",
+    "make_mesh_3d",
+    "factor_devices",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    lr: float = 1e-3
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    # topology spec for the gradient-sync allreduce (None -> FT_TOPO/flat).
+    # Either one spec — used on every mesh axis whose size matches its
+    # product, flat elsewhere — or a dict {axis_name: spec}.
+    grad_topo: Any = None
+
+
+def factor_devices(n: int) -> tuple[int, int, int]:
+    """Split ``n`` devices into a (dp, sp, tp) shape, most-square-first.
+
+    Greedy largest-prime-first assignment cycling dp -> sp -> tp, so 8 ->
+    (2, 2, 2), 4 -> (2, 2, 1), 12 -> (3, 2, 2), 1 -> (1, 1, 1).
+    """
+    factors = []
+    m, p = n, 2
+    while m > 1:
+        while m % p == 0:
+            factors.append(p)
+            m //= p
+        p += 1
+    dims = [1, 1, 1]
+    for i, f in enumerate(sorted(factors, reverse=True)):
+        dims[i % 3] *= f
+    return tuple(dims)
+
+
+def make_mesh_3d(
+    n_devices: int | None = None,
+    shape: tuple[int, int, int] | None = None,
+    axis_names: tuple[str, str, str] = ("dp", "sp", "tp"),
+) -> Mesh:
+    """A (dp, sp, tp) mesh over the first ``n_devices`` local devices."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if shape is None:
+        shape = factor_devices(n)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    return jax.make_mesh(shape, axis_names, devices=devs[:n])
+
+
+def init_train_state(key, cfg: TransformerConfig) -> dict:
+    params = init_params(key, cfg)
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    return {
+        "params": params,
+        "mu": zeros,
+        "nu": jax.tree.map(jnp.zeros_like, params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def state_specs(cfg: TransformerConfig, tp_axis: str | None = "tp") -> dict:
+    pspecs = param_specs(cfg, tp_axis)
+    return {
+        "params": pspecs,
+        "mu": jax.tree.map(lambda s: s, pspecs),
+        "nu": jax.tree.map(lambda s: s, pspecs),
+        "step": P(),
+    }
+
+
+def _replication_axes(spec: P, mesh_axes) -> tuple[str, ...]:
+    """Mesh axes a parameter with PartitionSpec ``spec`` is replicated on."""
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return tuple(a for a in mesh_axes if a not in used)
+
+
+def make_train_step(
+    mesh: Mesh,
+    model_cfg: TransformerConfig,
+    train_cfg: TrainConfig = TrainConfig(),
+    axis_names: tuple[str, str, str] = ("dp", "sp", "tp"),
+):
+    """Build the jitted full train step ``(state, tokens, targets) ->
+    (state, metrics)``.
+
+    ``tokens``/``targets``: (B, T) int32, batch sharded over dp, sequence
+    over sp.  ``metrics``: {'loss': global mean token loss}.
+    """
+    dp, sp, tp = axis_names
+    for a in axis_names:
+        if a not in mesh.shape:
+            raise ValueError(f"mesh is missing axis {a!r}; has {mesh.axis_names}")
+    tp_size = mesh.shape[tp]
+    if model_cfg.d_model % (model_cfg.n_heads) or model_cfg.n_heads % tp_size:
+        raise ValueError(
+            f"n_heads={model_cfg.n_heads} must be divisible by tp={tp_size}"
+        )
+    if model_cfg.d_ff % tp_size:
+        raise ValueError(f"d_ff={model_cfg.d_ff} must be divisible by tp={tp_size}")
+
+    sspecs = state_specs(model_cfg, tp)
+    data_spec = P(dp, sp)
+    mesh_axes = axis_names
+
+    def device_step(state, tokens, targets):
+        n_total_tokens = (
+            tokens.size
+            * lax.axis_size(dp)
+            * lax.axis_size(sp)
+            * lax.axis_size(tp)  # tp-fold redundancy, see module docstring
+        )
+
+        def local_loss(params):
+            logits = forward(
+                params, tokens, model_cfg, tp_axis=tp, sp_axis=sp
+            )
+            loss_sum, _ = cross_entropy_loss(logits, targets)
+            return loss_sum / n_total_tokens
+
+        loss, grads = jax.value_and_grad(local_loss)(state["params"])
+
+        # FlexTree gradient sync: sum each leaf over its replication axes.
+        def axis_topo(ax):
+            spec = train_cfg.grad_topo
+            if isinstance(spec, dict):
+                spec = spec.get(ax)
+            try:
+                return Topology.resolve(mesh.shape[ax], spec)
+            except TopologyError:
+                return Topology.flat(mesh.shape[ax])
+
+        topos = {ax: axis_topo(ax) for ax in mesh_axes}
+
+        def sync(g, spec):
+            for ax in _replication_axes(spec, mesh_axes):
+                g = allreduce(g, ax, topo=topos[ax], op="sum")
+            return g
+
+        grads = jax.tree.map(
+            sync, grads, sspecs["params"], is_leaf=lambda x: x is None
+        )
+        global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
+
+        # inline AdamW on the local shards
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        c1 = 1.0 - train_cfg.b1**t
+        c2 = 1.0 - train_cfg.b2**t
+
+        def upd(p, g, mu, nu):
+            mu = train_cfg.b1 * mu + (1.0 - train_cfg.b1) * g
+            nu = train_cfg.b2 * nu + (1.0 - train_cfg.b2) * (g * g)
+            delta = (mu / c1) / (jnp.sqrt(nu / c2) + train_cfg.eps)
+            if train_cfg.weight_decay:
+                delta = delta + train_cfg.weight_decay * p
+            return p - train_cfg.lr * delta, mu, nu
+
+        flat_p, treedef = jax.tree.flatten(state["params"])
+        flat_g = treedef.flatten_up_to(grads)
+        flat_mu = treedef.flatten_up_to(state["mu"])
+        flat_nu = treedef.flatten_up_to(state["nu"])
+        out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_mu, flat_nu)]
+        new_state = {
+            "params": treedef.unflatten([o[0] for o in out]),
+            "mu": treedef.unflatten([o[1] for o in out]),
+            "nu": treedef.unflatten([o[2] for o in out]),
+            "step": step,
+        }
+        return new_state, {"loss": global_loss}
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(sspecs, data_spec, data_spec),
+        out_specs=(sspecs, {"loss": P()}),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -1,11 +1,10 @@
 """Utilities: timing, logging, and result-file conventions."""
 
-from .logging import get_logger, log_if_rank0, result_file_name, write_result_file
+from .logging import get_logger, result_file_name, write_result_file
 from .timing import BenchResult, Timer, time_jax_fn
 
 __all__ = [
     "get_logger",
-    "log_if_rank0",
     "result_file_name",
     "write_result_file",
     "BenchResult",

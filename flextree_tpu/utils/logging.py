@@ -16,7 +16,7 @@ import os
 import time
 from pathlib import Path
 
-__all__ = ["get_logger", "log_if_rank0", "result_file_name", "write_result_file"]
+__all__ = ["get_logger", "result_file_name", "write_result_file"]
 
 _FMT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
 
@@ -30,13 +30,6 @@ def get_logger(name: str = "flextree") -> logging.Logger:
         logger.setLevel(os.environ.get("FT_LOG_LEVEL", "INFO"))
         logger.propagate = False
     return logger
-
-
-def log_if_rank0(logger: logging.Logger, msg: str, *args, rank: int = 0) -> None:
-    """The ``LOG_IF(INFO, total_peers == 0)`` pattern of the reference
-    benchmark (``benchmark.cpp:128-143``): only process/rank 0 speaks."""
-    if rank == 0:
-        logger.info(msg, *args)
 
 
 def result_file_name(
