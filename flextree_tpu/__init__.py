@@ -50,6 +50,14 @@ def __getattr__(name):
         from . import parallel
 
         return getattr(parallel, name)
+    if name in _MODEL_EXPORTS:
+        from . import models
+
+        return getattr(models, name)
+    if name in _INTERPOSE_EXPORTS:
+        from . import interpose
+
+        return getattr(interpose, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -63,4 +71,24 @@ _PARALLEL_EXPORTS = (
     "allreduce_over_mesh",
     "flat_mesh",
     "topology_from_mesh",
+    "ring_attention",
+    "attention_reference",
+    "TrainConfig",
+    "factor_devices",
+    "init_train_state",
+    "make_mesh_3d",
+    "make_train_step",
+    "state_specs",
 )
+
+# Names re-exported lazily from flextree_tpu.models (the model substrate).
+_MODEL_EXPORTS = (
+    "TransformerConfig",
+    "cross_entropy_loss",
+    "forward",
+    "init_params",
+    "param_specs",
+)
+
+# The lax.psum interposer (the reference's MPI_Allreduce shadowing analog).
+_INTERPOSE_EXPORTS = ("interposed", "install", "uninstall", "is_installed")

@@ -1,0 +1,112 @@
+"""Drop-in interposition: route ``jax.lax.psum`` through FlexTree.
+
+The reference's integration API is symbol shadowing: without
+``STANDALONE_TEST``, ``mpi_mod.hpp:1167-1171`` defines a file-static
+``MPI_Allreduce`` so any translation unit that includes the header silently
+runs FlexTree instead of libmpi — zero host-code changes.  The TPU-native
+analog shadows the public ``jax.lax.psum`` wrapper: inside the interposition
+scope, user code (or a host framework's gradient sync) calling
+``lax.psum(x, axis)`` gets the topology-parameterized hierarchical allreduce,
+with the stage widths read from the ``FT_TOPO`` environment variable exactly
+like the reference runtime (``mpi_mod.hpp:882-929``) unless given explicitly.
+
+Scope and fallbacks (mirroring the reference's entry-point routing,
+``mpi_mod.hpp:1181-1215``):
+
+- single named axis, sum over arrays -> FlexTree tree/ring per topology;
+- ``axis_index_groups``, multi-axis tuples, or anything else we don't
+  implement -> the original ``psum`` (the reference similarly leaves
+  non-SUM/BAND ops to the real MPI);
+- world size 1 -> identity fast path (handled inside ``allreduce``).
+
+Only the public wrapper is patched — JAX internals that bind the ``psum_p``
+primitive directly (e.g. grad-of-psum machinery) are untouched, so
+interposition cannot recurse or corrupt unrelated tracing.  The patch is
+process-global while installed (like the reference's link-time shadowing is
+TU-global); ``interposed()`` gives a scoped context manager, and
+``install()``/``uninstall()`` the explicit global switch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from .parallel.allreduce import allreduce
+
+__all__ = ["interposed", "install", "uninstall", "is_installed"]
+
+_lock = threading.Lock()
+_original_psum = None  # non-None iff installed
+
+
+def _make_psum(topo, min_size: int):
+    import jax.lax as _lax  # resolve the original once, at install time
+
+    orig = _lax.psum
+
+    def flextree_psum(x, axis_name, *, axis_index_groups=None):
+        if axis_index_groups is not None or not isinstance(axis_name, str):
+            return orig(x, axis_name, axis_index_groups=axis_index_groups)
+
+        def one(leaf):
+            leaf = jax.numpy.asarray(leaf)
+            if leaf.size < min_size:
+                return orig(leaf, axis_name)
+            return allreduce(leaf, axis_name, topo=topo, op="sum")
+
+        return jax.tree.map(one, x)
+
+    flextree_psum._flextree_interposer = True  # noqa: SLF001 (introspection tag)
+    flextree_psum._flextree_original = orig
+    return flextree_psum
+
+
+def install(topo=None, *, min_size: int = 0) -> None:
+    """Globally shadow ``jax.lax.psum`` with the FlexTree allreduce.
+
+    ``topo``: anything ``Topology.resolve`` accepts (None -> ``FT_TOPO`` env
+    at call time, else flat).  ``min_size``: leaves smaller than this many
+    elements keep the native psum (scalars like loss aggregation gain
+    nothing from a hierarchical schedule).
+    """
+    global _original_psum
+    with _lock:
+        if _original_psum is not None:
+            raise RuntimeError("FlexTree interposer is already installed")
+        shim = _make_psum(topo, min_size)
+        _original_psum = shim._flextree_original
+        jax.lax.psum = shim
+
+
+def uninstall() -> None:
+    """Restore the native ``jax.lax.psum``."""
+    global _original_psum
+    with _lock:
+        if _original_psum is None:
+            raise RuntimeError("FlexTree interposer is not installed")
+        jax.lax.psum = _original_psum
+        _original_psum = None
+
+
+def is_installed() -> bool:
+    return _original_psum is not None
+
+
+@contextlib.contextmanager
+def interposed(topo=None, *, min_size: int = 0):
+    """Scoped interposition: ``with interposed(topo="4,2"): ...``.
+
+    Functions *traced* inside the scope bake in the FlexTree lowering (XLA
+    compiles what was traced), so a jitted function first called inside the
+    scope keeps FlexTree semantics for its cached executable — the same
+    "whoever included the header got FlexTree forever" persistence as the
+    reference's shadowing, made explicit.
+    """
+    install(topo, min_size=min_size)
+    try:
+        yield
+    finally:
+        uninstall()

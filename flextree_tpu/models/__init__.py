@@ -1,0 +1,17 @@
+"""Model substrate: the flagship transformer LM the collectives serve."""
+
+from .transformer import (
+    TransformerConfig,
+    cross_entropy_loss,
+    forward,
+    init_params,
+    param_specs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "cross_entropy_loss",
+    "forward",
+    "init_params",
+    "param_specs",
+]

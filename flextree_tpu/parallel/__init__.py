@@ -1,7 +1,12 @@
-"""JAX/XLA collective backend: the TPU-native transport + algorithm layers."""
+"""JAX/XLA collective backend + parallelism strategies.
+
+Collectives (transport + algorithm layers, TPU-native), ring-attention
+sequence parallelism, and the dp/sp/tp sharded training step.
+"""
 
 from .allreduce import allgather, allreduce, reduce_scatter, ring_allreduce, tree_allreduce
 from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
+from .ring_attention import attention_reference, ring_attention
 
 __all__ = [
     "allreduce",
@@ -12,4 +17,32 @@ __all__ = [
     "allreduce_over_mesh",
     "flat_mesh",
     "topology_from_mesh",
+    "ring_attention",
+    "attention_reference",
+    "TrainConfig",
+    "factor_devices",
+    "init_train_state",
+    "make_mesh_3d",
+    "make_train_step",
+    "state_specs",
 ]
+
+# Lazy (PEP 562): .train imports ..models.transformer, which imports
+# .allreduce from this package — importing .train eagerly here would close
+# that loop into a circular import for any models-first import order.
+_TRAIN_EXPORTS = (
+    "TrainConfig",
+    "factor_devices",
+    "init_train_state",
+    "make_mesh_3d",
+    "make_train_step",
+    "state_specs",
+)
+
+
+def __getattr__(name):
+    if name in _TRAIN_EXPORTS:
+        from . import train
+
+        return getattr(train, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
