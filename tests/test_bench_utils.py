@@ -30,7 +30,10 @@ class TestTimer:
         a = t.elapsed_s
         b = t.elapsed_s
         assert b >= a >= 0
-        assert t.elapsed_ms == pytest.approx(t.elapsed_s * 1e3, rel=0.5)
+        t.stop()  # freeze so unit conversions read the same instant
+        assert t.elapsed_ms == pytest.approx(t.elapsed_s * 1e3)
+        assert t.elapsed_us == pytest.approx(t.elapsed_s * 1e6)
+        assert t.elapsed_ns == pytest.approx(t.elapsed_s * 1e9)
 
     def test_stop_freezes(self):
         t = Timer()
