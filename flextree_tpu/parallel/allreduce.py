@@ -106,24 +106,35 @@ def tree_allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
 
 
 def _tree_reduce_scatter(v, axis_name, topo: Topology, rop: ReduceOp):
-    """Phase 1: per-stage grouped reduce-scatter (``mpi_mod.hpp:988-1029``)."""
+    """Phase 1: per-stage grouped reduce-scatter (``mpi_mod.hpp:988-1029``).
+
+    Each stage runs under a ``jax.named_scope`` so profiler traces show the
+    per-stage breakdown the reference's ``SHOW_TIME`` phase logs gave
+    (``mpi_mod.hpp:34-38, 977-1031``).
+    """
     for i, w in enumerate(topo.widths):
-        groups = topo.groups(i)
-        if rop.name == "sum":
-            v = lax.psum_scatter(
-                v, axis_name, scatter_dimension=0, axis_index_groups=groups, tiled=True
-            )
-        else:
-            v = _grouped_reduce_scatter_generic(v, axis_name, topo, i, rop)
+        with jax.named_scope(f"ft_rs_stage{i}_w{w}"):
+            groups = topo.groups(i)
+            if rop.name == "sum":
+                v = lax.psum_scatter(
+                    v,
+                    axis_name,
+                    scatter_dimension=0,
+                    axis_index_groups=groups,
+                    tiled=True,
+                )
+            else:
+                v = _grouped_reduce_scatter_generic(v, axis_name, topo, i, rop)
     return v
 
 
 def _tree_allgather(v, axis_name, topo: Topology):
     """Phase 2: stages unwound in reverse (``mpi_mod.hpp:1050-1060``)."""
     for i in reversed(range(topo.num_stages)):
-        v = lax.all_gather(
-            v, axis_name, axis_index_groups=topo.groups(i), axis=0, tiled=True
-        )
+        with jax.named_scope(f"ft_ag_stage{i}_w{topo.widths[i]}"):
+            v = lax.all_gather(
+                v, axis_name, axis_index_groups=topo.groups(i), axis=0, tiled=True
+            )
     return v
 
 

@@ -15,8 +15,14 @@ from .plan import (
     ring_plan,
     format_plan,
 )
+from .validate import ScheduleError, ValidationStats, validate, validate_ring, validate_topology
 
 __all__ = [
+    "ScheduleError",
+    "ValidationStats",
+    "validate",
+    "validate_topology",
+    "validate_ring",
     "Topology",
     "TopologyError",
     "parse_topo",

@@ -1,6 +1,7 @@
-"""Utilities: timing, logging, and result-file conventions."""
+"""Utilities: timing, logging, profiling, and result-file conventions."""
 
 from .logging import get_logger, result_file_name, write_result_file
+from .profiling import PhaseTimer, debug_dump_schedule, debug_enabled, phase_timer, trace
 from .timing import BenchResult, Timer, time_jax_fn
 
 __all__ = [
@@ -10,4 +11,9 @@ __all__ = [
     "BenchResult",
     "Timer",
     "time_jax_fn",
+    "PhaseTimer",
+    "phase_timer",
+    "trace",
+    "debug_dump_schedule",
+    "debug_enabled",
 ]

@@ -1,0 +1,140 @@
+"""Profiling / tracing: the TPU-native rebuild of the reference's tracing
+scaffolding.
+
+The reference has two compile-time knobs (SURVEY §2.1 R13, §5):
+
+- ``SHOW_TIME`` — wall-clock deltas at phase boundaries via ``MPI_Wtime``
+  (``mpi_mod.hpp:34-38, 977, 1031, 1062``);
+- ``FT_DEBUG`` — verbose per-block send/recv/reduce traces
+  (``mpi_mod.hpp:686, 737, 807``).
+
+Here both become runtime facilities:
+
+- :func:`trace` wraps ``jax.profiler`` so a benchmark run produces a
+  TensorBoard-loadable trace; the per-stage ``jax.named_scope`` annotations
+  inside :mod:`flextree_tpu.parallel.allreduce` (``ft_rs_stage*`` /
+  ``ft_ag_stage*``) make the hierarchical phases visible in it — the
+  ``SHOW_TIME`` analog, but per-op on-device rather than host wall-clock.
+- :func:`phase_timer` is the in-process ``SHOW_TIME`` fallback when a full
+  profiler trace is overkill: named checkpoints with deltas, rank-0 gated
+  logging.
+- :func:`debug_dump_schedule` is the ``FT_DEBUG`` analog: a per-rank ASCII
+  dump of the full send/recv schedule (delegating to
+  ``flextree_tpu.schedule.plan.format_plan``), driven by the ``FT_DEBUG``
+  env var so the reference's workflow (rebuild with ``-DFT_DEBUG``) becomes
+  "set ``FT_DEBUG=1``".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+from .logging import get_logger
+
+__all__ = ["trace", "phase_timer", "PhaseTimer", "debug_dump_schedule", "debug_enabled"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Profile the enclosed block to ``log_dir`` (TensorBoard/XPlane format).
+
+    Usage::
+
+        with trace("/tmp/ft_trace"):
+            jax.block_until_ready(allreduce_over_mesh(x, mesh, topo="4,2"))
+
+    The stage scopes (``ft_rs_stage0_w4`` etc.) appear as named ranges over
+    the XLA collective ops they wrap.
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Named phase checkpoints with wall-clock deltas — the ``TIME_RESET`` /
+    ``TIME_LOG_IF`` pattern (``mpi_mod.hpp:34-38``) as an object.
+
+    ``log=True`` emits each checkpoint via the framework logger (rank-0
+    gating is the caller's concern, as in the reference's
+    ``LOG_IF(INFO, rank == 0)``).
+    """
+
+    def __init__(self, log: bool = False, logger_name: str = "flextree.phase"):
+        self._log = log
+        self._logger = get_logger(logger_name)
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._last = self._t0
+        self.phases: list[tuple[str, float]] = []
+
+    def checkpoint(self, name: str) -> float:
+        """Record time since the previous checkpoint under ``name``."""
+        now = time.perf_counter()
+        dt = now - self._last
+        self._last = now
+        self.phases.append((name, dt))
+        if self._log:
+            self._logger.info("phase %-24s %8.3f ms", name, dt * 1e3)
+        return dt
+
+    @property
+    def total_s(self) -> float:
+        return self._last - self._t0
+
+    def summary(self) -> str:
+        lines = [f"{n:<24} {dt * 1e3:8.3f} ms" for n, dt in self.phases]
+        lines.append(f"{'total':<24} {self.total_s * 1e3:8.3f} ms")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def phase_timer(log: bool = True):
+    """``with phase_timer() as pt: pt.checkpoint("reduce-scatter"); ...``
+
+    On exit the phase summary table is logged (the per-phase deltas plus the
+    total), so the scope has a visible end — the ``SHOW_TIME`` run footer.
+    """
+    pt = PhaseTimer(log=log)
+    try:
+        yield pt
+    finally:
+        if log and pt.phases:
+            pt._logger.info("phase summary:\n%s", pt.summary())
+
+
+def debug_enabled() -> bool:
+    """True when the ``FT_DEBUG`` env var is set to a truthy value."""
+    return os.environ.get("FT_DEBUG", "").strip().lower() not in (
+        "",
+        "0",
+        "false",
+        "no",
+        "off",
+    )
+
+
+def debug_dump_schedule(topo, rank: int | None = None, force: bool = False) -> str | None:
+    """Dump the per-rank schedule when ``FT_DEBUG`` is on (or ``force``).
+
+    ``topo`` is a ``flextree_tpu.schedule.stages.Topology``.  Returns the
+    dump string (also logged) or None when debug is off — mirrors the
+    reference's ``FT_DEBUG``-gated ``print_ops`` topology dumps
+    (``mpi_mod.hpp:105-131``, call sites under ``#ifdef FT_DEBUG``).
+    """
+    if not (force or debug_enabled()):
+        return None
+    from ..schedule.plan import format_plan
+
+    ranks = range(topo.num_nodes) if rank is None else (rank,)
+    out = "\n".join(format_plan(topo, r) for r in ranks)
+    get_logger("flextree.debug").info("\n%s", out)
+    return out
