@@ -5,6 +5,15 @@ sequence parallelism, and the dp/sp/tp sharded training step.
 """
 
 from .allreduce import allgather, allreduce, reduce_scatter, ring_allreduce, tree_allreduce
+from .launch import (
+    ClusterConfig,
+    dcn_axis_names,
+    flatten_mesh,
+    hybrid_mesh,
+    init_distributed,
+    plan_for_mesh,
+    topology_for_hybrid,
+)
 from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
 from .ring_attention import attention_reference, ring_attention
 
@@ -17,6 +26,13 @@ __all__ = [
     "allreduce_over_mesh",
     "flat_mesh",
     "topology_from_mesh",
+    "ClusterConfig",
+    "init_distributed",
+    "hybrid_mesh",
+    "flatten_mesh",
+    "dcn_axis_names",
+    "plan_for_mesh",
+    "topology_for_hybrid",
     "ring_attention",
     "attention_reference",
     "TrainConfig",

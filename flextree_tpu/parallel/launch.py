@@ -1,0 +1,274 @@
+"""Multi-host launch + hybrid DCN×ICI meshes — the deployment layer (L5).
+
+The reference's L5 is a ``Makefile`` that scp-deploys the binary to 16 hosts
+and an MPI hostfile naming the ranks (``allreduce_over_mpi/Makefile:8-24``,
+``mpi_config_file:1-16``; SURVEY §2.5).  On TPU the moral equivalents are:
+
+- **process bring-up**: ``jax.distributed.initialize`` — every host runs the
+  same program, the coordinator assigns process ids, and all devices become
+  globally addressable (the role ``mpirun -np N --hostfile`` plays for MPI);
+- **hostfile**: a small JSON cluster config naming the coordinator, process
+  count and this process's id (TPU pods auto-detect all three, so the file is
+  only needed off-pod / on GPU-style clusters);
+- **topology**: a *hybrid* mesh whose outer axes cross DCN (between slices)
+  and inner axes ride ICI (within a slice).  The planner prices DCN stages
+  with DCN constants (``flextree_tpu.planner.cost_model``), so the chosen
+  stage widths naturally do the hierarchical thing the reference's FlexTree
+  does across its two-level Ethernet fabric: few wide stages over the slow
+  links, more stages over the fast ones.
+
+Everything here degrades gracefully to single-process virtual-device runs so
+the full path is testable on 8 CPU devices (SURVEY §4's strategy).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..schedule.stages import Topology
+
+__all__ = [
+    "ClusterConfig",
+    "init_distributed",
+    "hybrid_mesh",
+    "flatten_mesh",
+    "dcn_axis_names",
+    "plan_for_mesh",
+    "topology_for_hybrid",
+]
+
+
+# --------------------------------------------------------------------------
+# cluster config — the mpi_config_file analog
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ClusterConfig:
+    """Process-level launch description (one file shared by every host).
+
+    ``coordinator``: ``host:port`` of process 0 (the reference's first
+    hostfile line is the de-facto coordinator).  ``num_processes``: total
+    JAX processes.  ``process_id``: this host's id — usually *not* stored in
+    the shared file but taken from the ``FT_PROCESS_ID`` env var or CLI, the
+    way MPI ranks come from the launcher, so the same file deploys
+    everywhere.  All fields optional: on TPU pods the runtime auto-detects
+    everything and ``ClusterConfig()`` is valid.
+    """
+
+    coordinator: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "ClusterConfig":
+        raw = json.loads(Path(path).read_text())
+        unknown = set(raw) - {"coordinator", "num_processes", "process_id"}
+        if unknown:
+            raise ValueError(f"unknown cluster-config keys: {sorted(unknown)}")
+        return cls(**raw)
+
+    @classmethod
+    def from_env(cls) -> "ClusterConfig":
+        """Read ``FT_COORDINATOR`` / ``FT_NUM_PROCESSES`` / ``FT_PROCESS_ID``
+        — the launcher-provided triple, like MPI rank env vars."""
+        num = os.environ.get("FT_NUM_PROCESSES")
+        pid = os.environ.get("FT_PROCESS_ID")
+        return cls(
+            coordinator=os.environ.get("FT_COORDINATOR"),
+            num_processes=int(num) if num else None,
+            process_id=int(pid) if pid else None,
+        )
+
+    def merged(self, other: "ClusterConfig") -> "ClusterConfig":
+        """Fields of ``other`` win where set (env overrides file)."""
+        return ClusterConfig(
+            coordinator=other.coordinator or self.coordinator,
+            num_processes=other.num_processes or self.num_processes,
+            process_id=other.process_id if other.process_id is not None else self.process_id,
+        )
+
+
+def init_distributed(config: ClusterConfig | str | Path | None = None) -> None:
+    """Bring up the multi-host runtime (idempotent).
+
+    ``config``: a :class:`ClusterConfig`, a path to its JSON file, or None.
+    Env vars (``FT_*``) override file values, mirroring how the reference's
+    runtime lets ``FT_TOPO`` override compiled-in defaults.  On TPU pods all
+    fields may be None — ``jax.distributed.initialize`` auto-detects.  No-op
+    when already initialized or when the world is one process with no
+    coordinator configured (the single-host dev loop).
+    """
+    if _distributed_client_active():
+        return  # already initialized by us or the runtime
+    cfg = (
+        config
+        if isinstance(config, ClusterConfig)
+        else ClusterConfig.from_file(config)
+        if config is not None
+        else ClusterConfig()
+    )
+    cfg = cfg.merged(ClusterConfig.from_env())
+    if cfg.coordinator is None and cfg.num_processes in (None, 1):
+        return  # single-process run: nothing to initialize
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+
+
+def _distributed_client_active() -> bool:
+    """Whether ``jax.distributed`` is already up, WITHOUT touching backends.
+
+    ``jax.process_count()`` initializes the XLA backends, after which
+    ``jax.distributed.initialize`` unconditionally raises — so idempotence
+    must be probed through the distributed global state instead.
+    """
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:  # pragma: no cover - private-API drift
+        return False
+
+
+# --------------------------------------------------------------------------
+# hybrid DCN x ICI meshes
+# --------------------------------------------------------------------------
+
+
+def dcn_axis_names(mesh: Mesh) -> tuple[str, ...]:
+    """Axis names this module marked as DCN when building ``mesh``."""
+    return tuple(n for n in mesh.axis_names if str(n).startswith("dcn"))
+
+
+def hybrid_mesh(
+    ici_shape: tuple[int, ...],
+    dcn_shape: tuple[int, ...] = (),
+    axis_names: tuple[str, ...] | None = None,
+    devices=None,
+) -> Mesh:
+    """A mesh whose leading axes cross DCN and trailing axes ride ICI.
+
+    ``ici_shape``: per-slice torus factorization, e.g. ``(4, 2)``.
+    ``dcn_shape``: slice grid, e.g. ``(2,)`` for two slices.  Axis names
+    default to ``("dcn0", ..., "ici0", ...)`` so :func:`dcn_axis_names`
+    (and through it :func:`plan_for_mesh`) can recover which axes pay DCN
+    constants.
+
+    On real multi-slice hardware this delegates to
+    ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` (which groups
+    devices by slice so each DCN axis really crosses slices); on
+    single-granule hardware or virtual CPU devices it falls back to a plain
+    reshape — same logical mesh, no physical grouping to respect.
+    """
+    if axis_names is None:
+        axis_names = tuple(f"dcn{i}" for i in range(len(dcn_shape))) + tuple(
+            f"ici{i}" for i in range(len(ici_shape))
+        )
+    if len(axis_names) != len(dcn_shape) + len(ici_shape):
+        raise ValueError(
+            f"{len(dcn_shape) + len(ici_shape)} axes but {len(axis_names)} names"
+        )
+    devs = list(devices) if devices is not None else jax.devices()
+    n = math.prod(dcn_shape) * math.prod(ici_shape)
+    if n > len(devs):
+        raise ValueError(f"mesh needs {n} devices, have {len(devs)}")
+    devs = devs[:n]
+
+    full_shape = tuple(dcn_shape) + tuple(ici_shape)
+    if dcn_shape and ici_shape and _is_multi_granule(devs):
+        from jax.experimental import mesh_utils
+
+        # create_hybrid_device_mesh wants dcn_mesh_shape the same length as
+        # mesh_shape and returns their ELEMENTWISE product as the shape,
+        # granule-major along each combined axis.  Fold the whole slice grid
+        # into the first axis, then split it back out: the result's axis 0
+        # has size prod(dcn)*ici_shape[0] with granules outermost, so a
+        # row-major reshape to (dcn..., ici...) keeps every dcn index on a
+        # single slice.
+        g = math.prod(dcn_shape)
+        dcn_full = (g,) + (1,) * (len(ici_shape) - 1)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), dcn_full, devices=devs
+        )
+        return Mesh(arr.reshape(full_shape), axis_names)
+    return Mesh(np.asarray(devs).reshape(full_shape), axis_names)
+
+
+def _is_multi_granule(devs) -> bool:
+    """True when devices span >1 slice/process granule (real DCN exists)."""
+    keys = set()
+    for d in devs:
+        keys.add(getattr(d, "slice_index", None))
+    if len(keys) > 1 and keys != {None}:
+        return True
+    return len({d.process_index for d in devs}) > 1
+
+
+# --------------------------------------------------------------------------
+# planner bridge: mesh -> DCN-aware topology
+# --------------------------------------------------------------------------
+
+
+def flatten_mesh(mesh: Mesh, axis_name: str = "ft") -> Mesh:
+    """Collapse a multi-axis mesh to 1-D, preserving device order.
+
+    The FlexTree allreduce runs over a *single* named axis (like
+    ``lax.psum``); a hybrid mesh is flattened row-major, so the linear rank
+    varies fastest along the *last* (innermost ICI) axis — early small-gap
+    stages then exchange between ICI neighbors and only the late wide-gap
+    stages cross DCN, exactly the hierarchy :func:`plan_for_mesh` prices.
+    """
+    return Mesh(mesh.devices.reshape(-1), (axis_name,))
+
+
+def plan_for_mesh(mesh: Mesh, nbytes: int, axis_names=None, params=None):
+    """Choose stage widths for a flattened allreduce over ``mesh``'s axes.
+
+    Runs the offline planner (``flextree_tpu.planner.choose_topology``) with
+    the mesh's physical shape, marking ``dcn*``-named axes so cross-slice
+    stages are priced with DCN constants.  Returns the planner's ``Plan``;
+    ``plan.topology`` drops into ``allreduce(topo=...)`` over
+    ``flatten_mesh(mesh)``.
+
+    Axis order: stage ``i``'s rank stride (gap) is ``prod(widths[:i])``, so
+    with the row-major flatten of :func:`flatten_mesh` the *first* widths
+    ride the *last* mesh axis.  The planner therefore sees the axis sizes
+    reversed (innermost first); the widths it returns are already in
+    execution (gap) order.
+
+    ``axis_names``: restrict to a subset of mesh axes (in mesh order) when
+    the allreduce spans only those — e.g. gradient sync over ``("dcn0",
+    "ici0")`` of a dp/tp mesh.
+    """
+    from ..planner import choose_topology
+    from ..planner.cost_model import TpuCostParams
+
+    names = tuple(axis_names) if axis_names is not None else tuple(mesh.axis_names)
+    gap_order = tuple(reversed(names))  # innermost (gap-1) axis first
+    shape = tuple(mesh.shape[a] for a in gap_order)
+    dcn = tuple(i for i, a in enumerate(gap_order) if str(a).startswith("dcn"))
+    n = math.prod(shape)
+    return choose_topology(
+        n,
+        nbytes,
+        params=params if params is not None else TpuCostParams(),
+        mesh_shape=shape,
+        dcn_axes=dcn,
+    )
+
+
+def topology_for_hybrid(mesh: Mesh, nbytes: int, axis_names=None) -> Topology:
+    """Shortcut: the winning :class:`Topology` from :func:`plan_for_mesh`."""
+    return plan_for_mesh(mesh, nbytes, axis_names=axis_names).topology

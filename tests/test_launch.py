@@ -1,0 +1,173 @@
+"""Tests for the deployment layer (L5): cluster config, multi-host init,
+hybrid DCN x ICI meshes, and the planner bridge.
+
+The reference's L5 is the Makefile scp-deploy + MPI hostfile
+(``allreduce_over_mpi/Makefile:8-24``, ``mpi_config_file``); here it's
+``jax.distributed`` bring-up plus hybrid mesh construction, simulated on 8
+virtual CPU devices (2 "slices" x 4 chips).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flextree_tpu.parallel import (
+    ClusterConfig,
+    allreduce_over_mesh,
+    dcn_axis_names,
+    flatten_mesh,
+    hybrid_mesh,
+    init_distributed,
+    plan_for_mesh,
+    topology_for_hybrid,
+)
+
+
+class TestClusterConfig:
+    def test_from_file(self, tmp_path):
+        p = tmp_path / "cluster.json"
+        p.write_text(json.dumps({"coordinator": "h0:1234", "num_processes": 4}))
+        cfg = ClusterConfig.from_file(p)
+        assert cfg.coordinator == "h0:1234"
+        assert cfg.num_processes == 4
+        assert cfg.process_id is None
+
+    def test_unknown_key_rejected(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"hosts": ["a", "b"]}))
+        with pytest.raises(ValueError, match="unknown cluster-config keys"):
+            ClusterConfig.from_file(p)
+
+    def test_env_overrides_file(self, monkeypatch):
+        monkeypatch.setenv("FT_PROCESS_ID", "3")
+        monkeypatch.setenv("FT_NUM_PROCESSES", "8")
+        base = ClusterConfig(coordinator="h0:1", num_processes=4)
+        merged = base.merged(ClusterConfig.from_env())
+        assert merged.num_processes == 8
+        assert merged.process_id == 3
+        assert merged.coordinator == "h0:1"  # file value survives
+
+    def test_init_single_process_noop(self, monkeypatch):
+        # no coordinator, one process: must not call jax.distributed
+        monkeypatch.delenv("FT_COORDINATOR", raising=False)
+        monkeypatch.delenv("FT_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("FT_PROCESS_ID", raising=False)
+        called = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: called.append(kw)
+        )
+        init_distributed()
+        assert called == []
+
+    def test_init_passes_config(self, monkeypatch):
+        called = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: called.append(kw)
+        )
+        init_distributed(ClusterConfig("h0:9999", 4, 2))
+        assert called == [
+            {"coordinator_address": "h0:9999", "num_processes": 4, "process_id": 2}
+        ]
+
+
+class TestHybridMesh:
+    def test_shapes_and_names(self):
+        m = hybrid_mesh(ici_shape=(2, 2), dcn_shape=(2,))
+        assert dict(m.shape) == {"dcn0": 2, "ici0": 2, "ici1": 2}
+        assert dcn_axis_names(m) == ("dcn0",)
+
+    def test_no_dcn(self):
+        m = hybrid_mesh(ici_shape=(4, 2))
+        assert dict(m.shape) == {"ici0": 4, "ici1": 2}
+        assert dcn_axis_names(m) == ()
+
+    def test_custom_names(self):
+        m = hybrid_mesh((4,), (2,), axis_names=("dcn_slice", "x"))
+        assert dcn_axis_names(m) == ("dcn_slice",)
+
+    def test_too_many_devices(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            hybrid_mesh((4, 2), (2,))
+
+    def test_bad_names_len(self):
+        with pytest.raises(ValueError, match="axes but"):
+            hybrid_mesh((4,), (2,), axis_names=("only-one",))
+
+    def test_granule_path_keeps_slices_intact(self, monkeypatch):
+        """On multi-slice hardware each dcn index must hold exactly one
+        slice's devices.  CPU devices have no slices, so replicate
+        create_hybrid_device_mesh's real contract (elementwise-product
+        shape, granules np.block'ed along the combined axes) with fake
+        granules and check the reshape logic in hybrid_mesh."""
+        import flextree_tpu.parallel.launch as L
+
+        devs = jax.devices()  # 8 virtual CPUs; granules = 2 fake slices of 4
+        granule_of = {id(d): i // 4 for i, d in enumerate(devs)}
+
+        def fake_hybrid(mesh_shape, dcn_mesh_shape, devices=None):
+            per = int(np.prod(mesh_shape))
+            granules = [devices[i : i + per] for i in range(0, len(devices), per)]
+            assert int(np.prod(dcn_mesh_shape)) == len(granules)
+            per_meshes = [
+                np.asarray(g, dtype=object).reshape(mesh_shape) for g in granules
+            ]
+            gm = np.arange(len(granules)).reshape(dcn_mesh_shape)
+            blocks = np.vectorize(lambda i: per_meshes[i], otypes=[object])(gm)
+            return np.block(blocks.tolist())
+
+        monkeypatch.setattr(L, "_is_multi_granule", lambda d: True)
+        from jax.experimental import mesh_utils
+
+        monkeypatch.setattr(
+            mesh_utils, "create_hybrid_device_mesh", fake_hybrid
+        )
+        m = hybrid_mesh(ici_shape=(2, 2), dcn_shape=(2,))
+        arr = m.devices
+        assert arr.shape == (2, 2, 2)
+        for dcn_idx in range(2):
+            slice_devs = arr[dcn_idx].reshape(-1)
+            assert {granule_of[id(d)] for d in slice_devs} == {dcn_idx}
+
+    def test_flatten_preserves_device_order(self):
+        m = hybrid_mesh((2, 2), (2,))
+        flat = flatten_mesh(m)
+        assert flat.axis_names == ("ft",)
+        assert list(flat.devices.reshape(-1)) == list(m.devices.reshape(-1))
+
+
+class TestPlannerBridge:
+    def test_plan_widths_cover_mesh(self):
+        m = hybrid_mesh((2, 2), (2,))
+        plan = plan_for_mesh(m, 64 << 20)
+        assert np.prod(plan.topology.widths) in (8, 1)  # tree or ring sentinel
+
+    def test_dcn_crossing_stage_is_last(self):
+        """With a DCN outer axis, the winning aligned shape should reduce
+        over ICI first (small gaps) and cross DCN in the final stage."""
+        m = hybrid_mesh((2, 2), (2,))
+        plan = plan_for_mesh(m, 256 << 20)
+        best = plan.candidates[0]
+        if best.torus_aligned and len(best.widths) >= 2:
+            # gap-order: last width rides the dcn axis (reversed shape puts
+            # dcn last); its width must cover the 2-slice axis
+            assert best.widths[-1] == 2
+
+    def test_subset_axes(self):
+        m = hybrid_mesh((2, 2), (2,))
+        plan = plan_for_mesh(m, 1 << 20, axis_names=("dcn0", "ici0"))
+        assert plan.num_nodes == 4
+
+    def test_end_to_end_hybrid_allreduce(self):
+        """Full flow: hybrid mesh -> plan -> flatten -> run -> correct."""
+        m = hybrid_mesh((2, 2), (2,))
+        topo = topology_for_hybrid(m, 4 << 10)
+        flat = flatten_mesh(m)
+        x = np.arange(8 * 24, dtype=np.float32).reshape(8, 24)
+        out = np.asarray(
+            jax.device_get(allreduce_over_mesh(jnp.asarray(x), flat, topo=topo))
+        )
+        np.testing.assert_allclose(out, np.tile(x.sum(0), (8, 1)), rtol=1e-5)
