@@ -22,6 +22,15 @@ _NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
 _LIB_NAME = "libflextree_planner.so"
 
 
+def _run_make(force: bool = False) -> bool:
+    cmd = ["make", "-C", str(_NATIVE_DIR)] + (["-B"] if force else [])
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
 @functools.lru_cache(maxsize=1)
 def load_native(build_if_missing: bool = True):
     """Load (building on first use if possible) the native planner library.
@@ -31,14 +40,7 @@ def load_native(build_if_missing: bool = True):
     """
     lib_path = _NATIVE_DIR / _LIB_NAME
     if not lib_path.exists() and build_if_missing:
-        try:
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except (subprocess.SubprocessError, OSError):
+        if not _run_make():
             return None
     if not lib_path.exists():
         return None
@@ -46,6 +48,26 @@ def load_native(build_if_missing: bool = True):
         lib = ctypes.CDLL(str(lib_path))
     except OSError:
         return None
+    if not hasattr(lib, "ft_validate"):
+        # stale library built from an older source tree (pre schedule-core).
+        # Rebuild, then load through a fresh temp copy: dlopen caches by
+        # path, so re-CDLL'ing the same file would return the old mapping.
+        if not (build_if_missing and _run_make(force=True)):
+            return None
+        import shutil
+        import tempfile
+
+        tmp = tempfile.NamedTemporaryFile(
+            suffix=".so", prefix="flextree_", delete=False
+        )
+        tmp.close()
+        try:
+            shutil.copy(lib_path, tmp.name)
+            lib = ctypes.CDLL(tmp.name)
+        except OSError:
+            return None
+        if not hasattr(lib, "ft_validate"):
+            return None
 
     lib.ft_count_shapes.restype = ctypes.c_uint64
     lib.ft_count_shapes.argtypes = [ctypes.c_uint64]

@@ -1,0 +1,154 @@
+"""ctypes bindings for the native schedule core (``native/flextree_schedule.cpp``).
+
+The reference's L2 schedule engine is native C++ (``mpi_mod.hpp:45-214``);
+ours keeps a native core too, sharing the library with the planner
+(``native/libflextree_planner.so``) and falling back to the pure-Python
+implementation (:mod:`flextree_tpu.schedule.plan`) when it isn't built.
+The Python side is the specification — ``tests/test_native_schedule.py``
+cross-validates every exported function against it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from .plan import Operation
+
+__all__ = [
+    "native_available",
+    "native_send_plan",
+    "native_recv_plan",
+    "native_ring_plan",
+    "native_validate",
+]
+
+_VALIDATE_ERRORS = {
+    -1: "invalid topology",
+    -2: "double-counted send block",
+    -3: "send set != owned set",
+    -4: "recv claims un-owned blocks",
+    -5: "final ownership not a tiling",
+    -6: "phase-2 restoration incomplete",
+}
+
+
+def _lib():
+    # the schedule core lives in the same shared object as the planner
+    from ..planner.native import load_native
+
+    lib = load_native()
+    if lib is None or not hasattr(lib, "ft_plan"):
+        return None
+    if not getattr(lib, "_ft_schedule_bound", False):
+        lib.ft_plan.restype = ctypes.c_int32
+        lib.ft_plan.argtypes = [
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.ft_ring_plan.restype = ctypes.c_int32
+        lib.ft_ring_plan.argtypes = [
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+        ]
+        lib.ft_validate.restype = ctypes.c_int32
+        lib.ft_validate.argtypes = [
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32,
+        ]
+        lib._ft_schedule_bound = True
+    return lib
+
+
+def native_available() -> bool:
+    return _lib() is not None
+
+
+def _plan(topo, rank: int, send: bool) -> list[list[Operation]] | None:
+    lib = _lib()
+    if lib is None:
+        return None
+    widths = (ctypes.c_uint32 * len(topo.widths))(*topo.widths)
+    needed = ctypes.c_uint64(0)
+    k = lib.ft_plan(
+        topo.num_nodes, rank, widths, len(topo.widths), int(send), None, 0,
+        ctypes.byref(needed),
+    )
+    if k < 0:
+        return None
+    buf = (ctypes.c_uint32 * max(1, needed.value))()
+    k = lib.ft_plan(
+        topo.num_nodes, rank, widths, len(topo.widths), int(send), buf,
+        needed.value, ctypes.byref(needed),
+    )
+    if k < 0:
+        return None
+    plan: list[list[Operation]] = []
+    off = 0
+    for _ in range(k):
+        num_ops = buf[off]
+        off += 1
+        ops = []
+        for _ in range(num_ops):
+            peer, nblocks = buf[off], buf[off + 1]
+            off += 2
+            ops.append(Operation(int(peer), tuple(int(b) for b in buf[off : off + nblocks])))
+            off += nblocks
+        plan.append(ops)
+    return plan
+
+
+def native_send_plan(topo, rank: int) -> list[list[Operation]] | None:
+    """Native ``send_plan``; None when the library isn't available."""
+    return _plan(topo, rank, send=True)
+
+
+def native_recv_plan(topo, rank: int) -> list[list[Operation]] | None:
+    """Native ``recv_plan``; None when the library isn't available."""
+    return _plan(topo, rank, send=False)
+
+
+def native_ring_plan(n: int, rank: int) -> list[tuple[Operation, Operation]] | None:
+    """Native ``ring_plan``; None when the library isn't available."""
+    lib = _lib()
+    if lib is None:
+        return None
+    steps = 2 * (n - 1)
+    buf = (ctypes.c_uint32 * max(1, steps * 4))()
+    got = lib.ft_ring_plan(n, rank, buf, steps * 4)
+    if got < 0:
+        return None
+    out = []
+    for s in range(got):
+        o = s * 4
+        out.append(
+            (
+                Operation.single(int(buf[o]), int(buf[o + 1])),
+                Operation.single(int(buf[o + 2]), int(buf[o + 3])),
+            )
+        )
+    return out
+
+
+def native_validate(topo) -> str | None:
+    """Run the native validator: '' on success, an error description on
+    violation, or None when the library isn't available.  The tree-only
+    native path is used; ring sentinels validate in Python."""
+    if topo.is_ring:
+        return None
+    lib = _lib()
+    if lib is None:
+        return None
+    widths = (ctypes.c_uint32 * len(topo.widths))(*topo.widths)
+    code = lib.ft_validate(topo.num_nodes, widths, len(topo.widths))
+    if code == 0:
+        return ""
+    return _VALIDATE_ERRORS.get(code, f"unknown error {code}")

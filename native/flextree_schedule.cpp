@@ -1,0 +1,229 @@
+// Native schedule core for flextree-tpu.
+//
+// TPU-native rebuild of the reference's L2 schedule engine — the pure-logic
+// layer the reference keeps deliberately transport-free (Operation /
+// Send_Ops / Recv_Ops / get_stages, mpi_mod.hpp:45-214, 882-929; the comment
+// at :78 mandates dependence on (total_peers, node_label, stages) only).
+// The reference implements this layer in native C++; so do we.  Semantics
+// mirror flextree_tpu/schedule/plan.py exactly (the Python side is the
+// spec; tests cross-validate the two).
+//
+// Also exposes a native schedule *validator* — the race-detection analog
+// (SURVEY §5): partition / send-recv agreement / plan-derived ownership
+// convergence / phase-2 restoration, the same invariants as
+// flextree_tpu/schedule/validate.py, usable from C++ hosts without Python.
+//
+// Serialization (all uint32): a plan is, per stage,
+//   [num_ops, then per op: peer, nblocks, b0, b1, ...]
+// Build: see native/Makefile.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Topo {
+  uint32_t n = 0;
+  std::vector<uint32_t> widths;
+  std::vector<uint32_t> gaps;  // gaps[i] = prod(widths[:i])
+
+  // returns false for invalid width vectors (product != n, width < 2)
+  bool init(uint64_t n_, const uint32_t* w, uint32_t k) {
+    n = static_cast<uint32_t>(n_);
+    widths.assign(w, w + k);
+    gaps.clear();
+    uint64_t g = 1;
+    for (uint32_t i = 0; i < k; ++i) {
+      if (widths[i] < 2) return false;
+      gaps.push_back(static_cast<uint32_t>(g));
+      g *= widths[i];
+    }
+    return g == n_ && k > 0;
+  }
+
+  // group of `rank` at stage i: {base + j*g} with
+  // base = (r / (g*w)) * (g*w) + r % g   (mpi_mod.hpp:162, 198)
+  void group(uint32_t stage, uint32_t rank, std::vector<uint32_t>& out) const {
+    const uint32_t g = gaps[stage], w = widths[stage];
+    const uint32_t base = (rank / (g * w)) * (g * w) + rank % g;
+    out.clear();
+    for (uint32_t j = 0; j < w; ++j) out.push_back(base + j * g);
+  }
+};
+
+// {b : b == rank (mod stride), b < n} — the residue chain
+void chain(uint32_t rank, uint32_t n, uint32_t stride, std::vector<uint32_t>& out) {
+  out.clear();
+  for (uint32_t b = rank % stride; b < n; b += stride) out.push_back(b);
+}
+
+// serialize one stage's ops: [num_ops, (peer, nblocks, blocks...)...]
+struct Writer {
+  uint32_t* buf;
+  uint64_t cap, off = 0;
+  bool counting;  // when true, only measure
+  explicit Writer(uint32_t* b, uint64_t c) : buf(b), cap(c), counting(b == nullptr) {}
+  bool put(uint32_t v) {
+    if (!counting) {
+      if (off >= cap) return false;
+      buf[off] = v;
+    }
+    ++off;
+    return true;
+  }
+  bool put_span(const std::vector<uint32_t>& v) {
+    for (uint32_t x : v)
+      if (!put(x)) return false;
+    return true;
+  }
+};
+
+// emit send or recv plan for `rank`; send: each group peer p gets chain(p,
+// n, g*w); recv: every op carries chain(rank, n, g*w)  (plan.py semantics)
+bool emit_plan(const Topo& t, uint32_t rank, bool send, Writer& wtr) {
+  std::vector<uint32_t> grp, blocks;
+  for (uint32_t i = 0; i < t.widths.size(); ++i) {
+    const uint32_t stride = t.gaps[i] * t.widths[i];
+    t.group(i, rank, grp);
+    if (!wtr.put(static_cast<uint32_t>(grp.size()))) return false;
+    for (uint32_t peer : grp) {
+      chain(send ? peer : rank, t.n, stride, blocks);
+      if (!wtr.put(peer)) return false;
+      if (!wtr.put(static_cast<uint32_t>(blocks.size()))) return false;
+      if (!wtr.put_span(blocks)) return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Serialize rank's send (send=1) or recv (send=0) plan.  Two-call pattern:
+// pass buf=nullptr to get *needed; then call with a buffer.  Returns the
+// number of stages, or -1 on invalid topology / short buffer.
+int32_t ft_plan(uint64_t n, uint32_t rank, const uint32_t* widths, uint32_t k,
+                int32_t send, uint32_t* buf, uint64_t buf_len, uint64_t* needed) {
+  Topo t;
+  if (!t.init(n, widths, k) || rank >= n) return -1;
+  Writer measure(nullptr, 0);
+  if (!emit_plan(t, rank, send != 0, measure)) return -1;
+  if (needed) *needed = measure.off;
+  if (buf == nullptr) return static_cast<int32_t>(k);
+  if (measure.off > buf_len) return -1;
+  Writer wtr(buf, buf_len);
+  if (!emit_plan(t, rank, send != 0, wtr)) return -1;
+  return static_cast<int32_t>(k);
+}
+
+// The 2(N-1)-step ring schedule for `rank` (plan.py::ring_plan,
+// mpi_mod.hpp:1119-1159).  Serialized as per-step records
+// [send_peer, send_block, recv_peer, recv_block]; buffer needs 8*(n-1)
+// uint32.  Returns the number of steps or -1.
+int32_t ft_ring_plan(uint64_t n, uint32_t rank, uint32_t* buf, uint64_t buf_len) {
+  if (n < 1 || rank >= n) return -1;
+  const uint32_t N = static_cast<uint32_t>(n);
+  const uint64_t steps = 2 * (n - 1);
+  if (buf_len < steps * 4) return -1;
+  const uint32_t left = (rank + N - 1) % N, right = (rank + 1) % N;
+  uint64_t off = 0;
+  uint32_t bs = rank, br = left;
+  for (uint32_t s = 0; s + 1 < N; ++s) {  // reduce-scatter walk
+    buf[off++] = right;
+    buf[off++] = bs;
+    buf[off++] = left;
+    buf[off++] = br;
+    bs = (bs + N - 1) % N;
+    br = (br + N - 1) % N;
+  }
+  bs = (rank + 1) % N;
+  br = rank;
+  for (uint32_t s = 0; s + 1 < N; ++s) {  // allgather walk
+    buf[off++] = right;
+    buf[off++] = bs;
+    buf[off++] = left;
+    buf[off++] = br;
+    bs = (bs + N - 1) % N;
+    br = (br + N - 1) % N;
+  }
+  return static_cast<int32_t>(steps);
+}
+
+// Native schedule validator.  Returns 0 when the topology's full schedule
+// satisfies every allreduce invariant; a negative code localizes the first
+// violation:
+//   -1 invalid topology          -4 recv claims un-owned blocks
+//   -2 double-counted send block -5 final ownership not a tiling
+//   -3 send set != owned set     -6 phase-2 restoration incomplete
+int32_t ft_validate(uint64_t n, const uint32_t* widths, uint32_t k) {
+  Topo t;
+  if (!t.init(n, widths, k)) return -1;
+  const uint32_t N = t.n;
+  std::vector<uint32_t> grp, blocks;
+
+  // owned[r] = bitmask over blocks, derived from the plans stage by stage
+  std::vector<std::vector<bool>> owned(N, std::vector<bool>(N, true));
+  for (uint32_t i = 0; i < k; ++i) {
+    const uint32_t stride = t.gaps[i] * t.widths[i];
+    std::vector<std::vector<bool>> next(N, std::vector<bool>(N, false));
+    for (uint32_t r = 0; r < N; ++r) {
+      t.group(i, r, grp);
+      std::vector<bool> sent(N, false);
+      for (uint32_t peer : grp) {
+        chain(peer, N, stride, blocks);  // what r sends to peer
+        for (uint32_t b : blocks) {
+          if (sent[b]) return -2;
+          sent[b] = true;
+        }
+        // agreement is structural here: the receiver's expected set is
+        // chain(peer, stride) by construction, identical to what we send
+      }
+      for (uint32_t b = 0; b < N; ++b)
+        if (sent[b] != owned[r][b]) return -3;
+      chain(r, N, stride, blocks);  // what r keeps (its recv set)
+      for (uint32_t b : blocks) {
+        if (!owned[r][b]) return -4;
+        next[r][b] = true;
+      }
+    }
+    owned.swap(next);
+  }
+  // final ownership tiles [0, N)
+  std::vector<int32_t> holder(N, -1);
+  for (uint32_t r = 0; r < N; ++r)
+    for (uint32_t b = 0; b < N; ++b)
+      if (owned[r][b]) {
+        if (holder[b] != -1) return -5;
+        holder[b] = static_cast<int32_t>(r);
+      }
+  for (uint32_t b = 0; b < N; ++b)
+    if (holder[b] == -1) return -5;
+
+  // phase 2 replay: stages reversed, roles swapped; every rank must end
+  // holding all N blocks, never receiving a block its peer doesn't hold
+  std::vector<std::vector<bool>> hold = owned;
+  for (int32_t i = static_cast<int32_t>(k) - 1; i >= 0; --i) {
+    const uint32_t stride = t.gaps[i] * t.widths[i];
+    std::vector<std::vector<bool>> next = hold;
+    for (uint32_t r = 0; r < N; ++r) {
+      t.group(static_cast<uint32_t>(i), r, grp);
+      for (uint32_t peer : grp) {
+        if (peer == r) continue;
+        chain(peer, N, stride, blocks);  // peer forwards its own chain
+        for (uint32_t b : blocks) {
+          if (!hold[peer][b]) return -6;
+          next[r][b] = true;
+        }
+      }
+    }
+    hold.swap(next);
+  }
+  for (uint32_t r = 0; r < N; ++r)
+    for (uint32_t b = 0; b < N; ++b)
+      if (!hold[r][b]) return -6;
+  return 0;
+}
+
+}  // extern "C"
