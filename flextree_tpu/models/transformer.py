@@ -12,8 +12,10 @@ is MXU-friendly and mesh-native:
   ``flextree_tpu.parallel.allreduce`` — our collective is the TP backend,
   the moral equivalent of the reference interposing its allreduce under a
   host framework (``mpi_mod.hpp:1167-1171``).
-- **Sequence parallelism** over the ``sp`` mesh axis via
-  ``ring_attention`` (K/V blocks walk the ring, flash-style accumulation).
+- **Sequence parallelism** over the ``sp`` mesh axis, strategy selected by
+  ``sp_impl``: ``ring_attention`` (K/V blocks walk the ring, flash-style
+  accumulation) or ``ulysses_attention`` (all-to-all head/sequence
+  re-shard, full-sequence local attention).
 - **RoPE** positions (global offsets derived from the ``sp`` axis index),
   RMSNorm, GELU MLP, tied input/output embeddings — no learned position
   table, so sequence length is bounded only by memory.
@@ -39,12 +41,15 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.allreduce import allreduce
 from ..parallel.ring_attention import attention_reference, ring_attention
+from ..parallel.ulysses import ulysses_attention
 
 __all__ = [
     "TransformerConfig",
     "init_params",
     "param_specs",
     "forward",
+    "layer_forward",
+    "global_positions",
     "cross_entropy_loss",
 ]
 
@@ -60,6 +65,10 @@ class TransformerConfig:
     dtype: Any = jnp.float32  # compute dtype; params stay float32
     # topology spec for the TP-combining allreduce (None -> FT_TOPO/flat)
     tp_topo: Any = None
+    # sequence-parallel attention strategy: "ring" (K/V walk the ring,
+    # heads unconstrained) or "ulysses" (two all-to-alls, needs the local
+    # head count divisible by the sp axis size)
+    sp_impl: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -150,6 +159,53 @@ def _tp_combine(partial, tp_axis, cfg: TransformerConfig):
     return allreduce(partial, tp_axis, topo=cfg.tp_topo, op="sum")
 
 
+def layer_forward(
+    layer,
+    x,
+    positions,
+    cfg: TransformerConfig,
+    *,
+    tp_axis: str | None = None,
+    sp_axis: str | None = None,
+):
+    """One transformer block on hidden states ``x`` (B, T_local, d).
+
+    ``positions``: (T_local,) global token positions (RoPE + causal mask).
+    Factored out of :func:`forward` so the pipeline-parallel runner
+    (``flextree_tpu.parallel.pipeline``) can ``lax.scan`` it over a stacked
+    per-stage parameter slice.
+    """
+    b, t_local, _ = x.shape
+    head_dim = cfg.head_dim
+    h = rms_norm(x, layer["ln1"])
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    if sp_axis is None:
+        attn = attention_reference(q, k, v, causal=True)
+    elif cfg.sp_impl == "ulysses":
+        attn = ulysses_attention(q, k, v, sp_axis, causal=True)
+    elif cfg.sp_impl == "ring":
+        attn = ring_attention(q, k, v, sp_axis, causal=True)
+    else:
+        raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}")
+    o = attn.reshape(b, t_local, -1) @ layer["wo"].astype(cfg.dtype)
+    x = x + _tp_combine(o, tp_axis, cfg)
+
+    h = rms_norm(x, layer["ln2"])
+    u = jax.nn.gelu(h @ layer["w1"].astype(cfg.dtype))
+    y = u @ layer["w2"].astype(cfg.dtype)
+    return x + _tp_combine(y, tp_axis, cfg)
+
+
+def global_positions(t_local: int, sp_axis: str | None):
+    """(T_local,) global positions for this device's sequence shard."""
+    offset = lax.axis_index(sp_axis) * t_local if sp_axis is not None else 0
+    return offset + jnp.arange(t_local)
+
+
 def forward(
     params,
     tokens,
@@ -166,34 +222,12 @@ def forward(
     pre-sliced by ``param_specs``).  Returns (B, T_local, vocab) logits in
     float32, replicated over ``tp_axis``.
     """
-    b, t_local = tokens.shape
-    if sp_axis is not None:
-        offset = lax.axis_index(sp_axis) * t_local
-    else:
-        offset = 0
-    positions = offset + jnp.arange(t_local)
-
+    positions = global_positions(tokens.shape[1], sp_axis)
     x = params["embed"][tokens].astype(cfg.dtype)
-    head_dim = cfg.head_dim
     for layer in params["layers"]:
-        h = rms_norm(x, layer["ln1"])
-        q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
-        k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
-        v = (h @ layer["wv"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
-        if sp_axis is not None:
-            attn = ring_attention(q, k, v, sp_axis, causal=True)
-        else:
-            attn = attention_reference(q, k, v, causal=True)
-        o = attn.reshape(b, t_local, -1) @ layer["wo"].astype(cfg.dtype)
-        x = x + _tp_combine(o, tp_axis, cfg)
-
-        h = rms_norm(x, layer["ln2"])
-        u = jax.nn.gelu(h @ layer["w1"].astype(cfg.dtype))
-        y = u @ layer["w2"].astype(cfg.dtype)
-        x = x + _tp_combine(y, tp_axis, cfg)
-
+        x = layer_forward(
+            layer, x, positions, cfg, tp_axis=tp_axis, sp_axis=sp_axis
+        )
     x = rms_norm(x, params["ln_f"])
     logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
     return logits

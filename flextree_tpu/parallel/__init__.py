@@ -16,6 +16,7 @@ from .launch import (
 )
 from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
 from .ring_attention import attention_reference, ring_attention
+from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
 
 __all__ = [
     "allreduce",
@@ -35,17 +36,23 @@ __all__ = [
     "topology_for_hybrid",
     "ring_attention",
     "attention_reference",
+    "ulysses_attention",
+    "seq_to_heads",
+    "heads_to_seq",
     "TrainConfig",
     "factor_devices",
     "init_train_state",
     "make_mesh_3d",
     "make_train_step",
     "state_specs",
+    "resolve_axis_topos",
+    "sync_grads",
+    "adamw_apply",
 ]
 
-# Lazy (PEP 562): .train imports ..models.transformer, which imports
-# .allreduce from this package — importing .train eagerly here would close
-# that loop into a circular import for any models-first import order.
+# Lazy (PEP 562): .train/.pipeline import ..models.transformer, which
+# imports .allreduce from this package — importing them eagerly here would
+# close that loop into a circular import for any models-first import order.
 _TRAIN_EXPORTS = (
     "TrainConfig",
     "factor_devices",
@@ -53,7 +60,23 @@ _TRAIN_EXPORTS = (
     "make_mesh_3d",
     "make_train_step",
     "state_specs",
+    "resolve_axis_topos",
+    "sync_grads",
+    "adamw_apply",
 )
+
+_PIPELINE_EXPORTS = (
+    "stack_layer_params",
+    "unstack_layer_params",
+    "pipeline_param_specs",
+    "pipeline_state_specs",
+    "init_pipeline_train_state",
+    "make_pipeline_train_step",
+    "make_mesh_4d",
+    "factor_devices_4d",
+)
+
+__all__ += list(_PIPELINE_EXPORTS)
 
 
 def __getattr__(name):
@@ -61,4 +84,8 @@ def __getattr__(name):
         from . import train
 
         return getattr(train, name)
+    if name in _PIPELINE_EXPORTS:
+        from . import pipeline
+
+        return getattr(pipeline, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -60,6 +60,9 @@ __all__ = [
     "make_train_step",
     "make_mesh_3d",
     "factor_devices",
+    "resolve_axis_topos",
+    "sync_grads",
+    "adamw_apply",
 ]
 
 
@@ -76,12 +79,10 @@ class TrainConfig:
     grad_topo: Any = None
 
 
-def factor_devices(n: int) -> tuple[int, int, int]:
-    """Split ``n`` devices into a (dp, sp, tp) shape, most-square-first.
-
-    Greedy largest-prime-first assignment cycling dp -> sp -> tp, so 8 ->
-    (2, 2, 2), 4 -> (2, 2, 1), 12 -> (3, 2, 2), 1 -> (1, 1, 1).
-    """
+def prime_factors(n: int) -> list[int]:
+    """Prime factors of ``n`` by trial division (ascending, with
+    multiplicity) — the planner-side twin is
+    ``flextree_tpu.planner.factorize``."""
     factors = []
     m, p = n, 2
     while m > 1:
@@ -89,10 +90,38 @@ def factor_devices(n: int) -> tuple[int, int, int]:
             factors.append(p)
             m //= p
         p += 1
-    dims = [1, 1, 1]
-    for i, f in enumerate(sorted(factors, reverse=True)):
-        dims[i % 3] *= f
+    return factors
+
+
+def spread_factors(n: int, n_dims: int, order: list[int] | None = None) -> tuple:
+    """Split ``n`` into ``n_dims`` near-balanced dims: largest prime factors
+    first, assigned round-robin over ``order`` (default 0..n_dims-1)."""
+    if order is None:
+        order = list(range(n_dims))
+    dims = [1] * n_dims
+    for i, f in enumerate(sorted(prime_factors(n), reverse=True)):
+        dims[order[i % n_dims]] *= f
     return tuple(dims)
+
+
+def make_mesh_nd(n_devices: int | None, shape, axis_names) -> Mesh:
+    """A mesh of ``shape`` x ``axis_names`` over the first n local devices."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, only {len(devs)} visible")
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    return jax.make_mesh(shape, axis_names, devices=devs[:n])
+
+
+def factor_devices(n: int) -> tuple[int, int, int]:
+    """Split ``n`` devices into a (dp, sp, tp) shape, most-square-first.
+
+    Greedy largest-prime-first assignment cycling dp -> sp -> tp, so 8 ->
+    (2, 2, 2), 4 -> (2, 2, 1), 12 -> (3, 2, 2), 1 -> (1, 1, 1).
+    """
+    return spread_factors(n, 3)
 
 
 def make_mesh_3d(
@@ -101,13 +130,11 @@ def make_mesh_3d(
     axis_names: tuple[str, str, str] = ("dp", "sp", "tp"),
 ) -> Mesh:
     """A (dp, sp, tp) mesh over the first ``n_devices`` local devices."""
-    devs = jax.devices()
-    n = len(devs) if n_devices is None else n_devices
     if shape is None:
-        shape = factor_devices(n)
-    if math.prod(shape) != n:
-        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
-    return jax.make_mesh(shape, axis_names, devices=devs[:n])
+        shape = factor_devices(
+            len(jax.devices()) if n_devices is None else n_devices
+        )
+    return make_mesh_nd(n_devices, shape, axis_names)
 
 
 def init_train_state(key, cfg: TransformerConfig) -> dict:
@@ -142,6 +169,64 @@ def _replication_axes(spec: P, mesh_axes) -> tuple[str, ...]:
         else:
             used.add(entry)
     return tuple(a for a in mesh_axes if a not in used)
+
+
+def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
+    """Per-axis FlexTree topology for the gradient sync.
+
+    ``grad_topo``: a single spec (used on each axis whose size its product
+    matches, flat elsewhere) or a dict ``{axis_name: spec}``.
+    """
+
+    def axis_topo(ax):
+        spec = grad_topo
+        if isinstance(spec, dict):
+            spec = spec.get(ax)
+        try:
+            return Topology.resolve(mesh.shape[ax], spec)
+        except TopologyError:
+            return Topology.flat(mesh.shape[ax])
+
+    return {ax: axis_topo(ax) for ax in mesh_axes}
+
+
+def sync_grads(grads, pspecs, mesh_axes, topos: dict):
+    """FlexTree gradient sync: sum each leaf over its replication axes."""
+
+    def sync(g, spec):
+        for ax in _replication_axes(spec, mesh_axes):
+            g = allreduce(g, ax, topo=topos[ax], op="sum")
+        return g
+
+    return jax.tree.map(sync, grads, pspecs, is_leaf=lambda x: x is None)
+
+
+def adamw_apply(state: dict, grads, train_cfg: "TrainConfig") -> dict:
+    """One AdamW update on (sharded) state; moments shard like the params."""
+    step = state["step"] + 1
+    t = step.astype(jnp.float32)
+    c1 = 1.0 - train_cfg.b1**t
+    c2 = 1.0 - train_cfg.b2**t
+
+    def upd(p, g, mu, nu):
+        mu = train_cfg.b1 * mu + (1.0 - train_cfg.b1) * g
+        nu = train_cfg.b2 * nu + (1.0 - train_cfg.b2) * (g * g)
+        delta = (mu / c1) / (jnp.sqrt(nu / c2) + train_cfg.eps)
+        if train_cfg.weight_decay:
+            delta = delta + train_cfg.weight_decay * p
+        return p - train_cfg.lr * delta, mu, nu
+
+    flat_p, treedef = jax.tree.flatten(state["params"])
+    flat_g = treedef.flatten_up_to(grads)
+    flat_mu = treedef.flatten_up_to(state["mu"])
+    flat_nu = treedef.flatten_up_to(state["nu"])
+    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_mu, flat_nu)]
+    return {
+        "params": treedef.unflatten([o[0] for o in out]),
+        "mu": treedef.unflatten([o[1] for o in out]),
+        "nu": treedef.unflatten([o[2] for o in out]),
+        "step": step,
+    }
 
 
 def make_train_step(
@@ -189,53 +274,11 @@ def make_train_step(
 
         loss, grads = jax.value_and_grad(local_loss)(state["params"])
 
-        # FlexTree gradient sync: sum each leaf over its replication axes.
-        def axis_topo(ax):
-            spec = train_cfg.grad_topo
-            if isinstance(spec, dict):
-                spec = spec.get(ax)
-            try:
-                return Topology.resolve(mesh.shape[ax], spec)
-            except TopologyError:
-                return Topology.flat(mesh.shape[ax])
-
-        topos = {ax: axis_topo(ax) for ax in mesh_axes}
-
-        def sync(g, spec):
-            for ax in _replication_axes(spec, mesh_axes):
-                g = allreduce(g, ax, topo=topos[ax], op="sum")
-            return g
-
-        grads = jax.tree.map(
-            sync, grads, sspecs["params"], is_leaf=lambda x: x is None
-        )
+        topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
+        grads = sync_grads(grads, sspecs["params"], mesh_axes, topos)
         global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
 
-        # inline AdamW on the local shards
-        step = state["step"] + 1
-        t = step.astype(jnp.float32)
-        c1 = 1.0 - train_cfg.b1**t
-        c2 = 1.0 - train_cfg.b2**t
-
-        def upd(p, g, mu, nu):
-            mu = train_cfg.b1 * mu + (1.0 - train_cfg.b1) * g
-            nu = train_cfg.b2 * nu + (1.0 - train_cfg.b2) * (g * g)
-            delta = (mu / c1) / (jnp.sqrt(nu / c2) + train_cfg.eps)
-            if train_cfg.weight_decay:
-                delta = delta + train_cfg.weight_decay * p
-            return p - train_cfg.lr * delta, mu, nu
-
-        flat_p, treedef = jax.tree.flatten(state["params"])
-        flat_g = treedef.flatten_up_to(grads)
-        flat_mu = treedef.flatten_up_to(state["mu"])
-        flat_nu = treedef.flatten_up_to(state["nu"])
-        out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_mu, flat_nu)]
-        new_state = {
-            "params": treedef.unflatten([o[0] for o in out]),
-            "mu": treedef.unflatten([o[1] for o in out]),
-            "nu": treedef.unflatten([o[2] for o in out]),
-            "step": step,
-        }
+        new_state = adamw_apply(state, grads, train_cfg)
         return new_state, {"loss": global_loss}
 
     sharded = jax.shard_map(

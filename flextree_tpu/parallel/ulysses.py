@@ -1,0 +1,79 @@
+"""Ulysses sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second of the framework's two long-context strategies (the first is
+``ring_attention``).  Where ring attention keeps queries resident and walks
+K/V around the ring — communication O(n) neighbor hops overlapping compute —
+Ulysses re-shards *once* per direction: an all-to-all converts the layout
+from sequence-sharded/(all heads) to head-sharded/(full sequence), exact
+attention runs locally over the full sequence, and a second all-to-all
+restores the sequence-sharded layout.  On a TPU mesh ``lax.all_to_all``
+lowers to a single XLA AllToAll over ICI, so the whole exchange is two
+collectives regardless of sequence length — the better trade when the head
+count comfortably covers the axis and the sequence is long enough that the
+ring's n-step latency chain dominates.
+
+This is the all-to-all counterpart of the reference's configurable-topology
+idea (``allreduce_over_mpi/mpi_mod.hpp:882-929``): the same computation,
+parameterized by *which* communication schedule realizes it; callers pick
+per workload (``flextree_tpu.models.transformer.TransformerConfig.sp_impl``).
+
+Collective-context functions: call inside ``shard_map`` with the sequence
+axis bound, like ``lax.psum``.  Differentiable — ``all_to_all`` transposes
+to the inverse all-to-all, so gradients re-shard exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from .ring_attention import attention_reference
+
+__all__ = ["ulysses_attention", "seq_to_heads", "heads_to_seq"]
+
+
+def seq_to_heads(x, axis_name):
+    """(B, T/n, H, D) sequence-sharded -> (B, T, H/n, D) head-sharded.
+
+    One ``lax.all_to_all`` over ``axis_name``: splits the head axis into
+    ``n`` groups, concatenates the sequence shards — afterwards each device
+    holds the *full* sequence for ``H/n`` of the heads.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if x.shape[2] % n:
+        raise ValueError(
+            f"Ulysses needs heads ({x.shape[2]}) divisible by the sequence "
+            f"axis size ({n}); use ring attention for head-poor models"
+        )
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def heads_to_seq(x, axis_name):
+    """Inverse of :func:`seq_to_heads`: back to sequence-sharded layout."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal: bool = True,
+                      scale: float | None = None):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Same contract as ``ring_attention``: ``q``/``k``/``v`` are
+    (B, T_local, H, D) sequence shards (global sequence = concatenation over
+    the axis in index order), result is the (B, T_local, H, D) attention
+    output for the local queries in ``q``'s dtype.  Requires ``H`` divisible
+    by the axis size.  Causality falls out naturally: after the re-shard the
+    full sequence is local, so the plain causal mask is already global.
+    """
+    with jax.named_scope("ulysses_seq2head"):
+        qh = seq_to_heads(q, axis_name)
+        kh = seq_to_heads(k, axis_name)
+        vh = seq_to_heads(v, axis_name)
+    with jax.named_scope("ulysses_local_attn"):
+        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    with jax.named_scope("ulysses_head2seq"):
+        return heads_to_seq(out, axis_name)
