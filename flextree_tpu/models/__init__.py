@@ -1,10 +1,19 @@
-"""Model substrate: the flagship transformer LM the collectives serve."""
+"""Model substrate the collectives serve: dense transformer LM + MoE LM."""
 
+from .moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_forward,
+    moe_layer,
+    moe_param_specs,
+)
 from .transformer import (
     TransformerConfig,
+    attention_block,
     cross_entropy_loss,
     forward,
     init_params,
+    layer_forward,
     param_specs,
 )
 
@@ -12,6 +21,13 @@ __all__ = [
     "TransformerConfig",
     "cross_entropy_loss",
     "forward",
+    "layer_forward",
+    "attention_block",
     "init_params",
     "param_specs",
+    "MoEConfig",
+    "init_moe_params",
+    "moe_forward",
+    "moe_layer",
+    "moe_param_specs",
 ]

@@ -49,6 +49,8 @@ __all__ = [
     "param_specs",
     "forward",
     "layer_forward",
+    "attention_block",
+    "mlp_block",
     "global_positions",
     "cross_entropy_loss",
 ]
@@ -159,7 +161,7 @@ def _tp_combine(partial, tp_axis, cfg: TransformerConfig):
     return allreduce(partial, tp_axis, topo=cfg.tp_topo, op="sum")
 
 
-def layer_forward(
+def attention_block(
     layer,
     x,
     positions,
@@ -168,13 +170,9 @@ def layer_forward(
     tp_axis: str | None = None,
     sp_axis: str | None = None,
 ):
-    """One transformer block on hidden states ``x`` (B, T_local, d).
-
-    ``positions``: (T_local,) global token positions (RoPE + causal mask).
-    Factored out of :func:`forward` so the pipeline-parallel runner
-    (``flextree_tpu.parallel.pipeline``) can ``lax.scan`` it over a stacked
-    per-stage parameter slice.
-    """
+    """Pre-norm attention residual half of a block (shared by the dense and
+    MoE models): ``x + W_o attn(RoPE(QKV(norm(x))))`` with the row-parallel
+    output combined through the FlexTree allreduce."""
     b, t_local, _ = x.shape
     head_dim = cfg.head_dim
     h = rms_norm(x, layer["ln1"])
@@ -192,8 +190,33 @@ def layer_forward(
     else:
         raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}")
     o = attn.reshape(b, t_local, -1) @ layer["wo"].astype(cfg.dtype)
-    x = x + _tp_combine(o, tp_axis, cfg)
+    return x + _tp_combine(o, tp_axis, cfg)
 
+
+def layer_forward(
+    layer,
+    x,
+    positions,
+    cfg: TransformerConfig,
+    *,
+    tp_axis: str | None = None,
+    sp_axis: str | None = None,
+):
+    """One transformer block on hidden states ``x`` (B, T_local, d).
+
+    ``positions``: (T_local,) global token positions (RoPE + causal mask).
+    Factored out of :func:`forward` so the pipeline-parallel runner
+    (``flextree_tpu.parallel.pipeline``) can ``lax.scan`` it over a stacked
+    per-stage parameter slice.
+    """
+    x = attention_block(
+        layer, x, positions, cfg, tp_axis=tp_axis, sp_axis=sp_axis
+    )
+    return mlp_block(layer, x, cfg, tp_axis=tp_axis)
+
+
+def mlp_block(layer, x, cfg: TransformerConfig, *, tp_axis: str | None = None):
+    """Pre-norm GELU MLP residual half (column/row-parallel over tp)."""
     h = rms_norm(x, layer["ln2"])
     u = jax.nn.gelu(h @ layer["w1"].astype(cfg.dtype))
     y = u @ layer["w2"].astype(cfg.dtype)

@@ -76,7 +76,15 @@ _PIPELINE_EXPORTS = (
     "factor_devices_4d",
 )
 
-__all__ += list(_PIPELINE_EXPORTS)
+_MOE_EXPORTS = (
+    "init_moe_train_state",
+    "moe_state_specs",
+    "make_moe_train_step",
+    "make_mesh_moe",
+    "factor_devices_moe",
+)
+
+__all__ += list(_PIPELINE_EXPORTS) + list(_MOE_EXPORTS)
 
 
 def __getattr__(name):
@@ -88,4 +96,8 @@ def __getattr__(name):
         from . import pipeline
 
         return getattr(pipeline, name)
+    if name in _MOE_EXPORTS:
+        from . import moe_train
+
+        return getattr(moe_train, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,157 @@
+"""Training step for the MoE model: dp x ep x sp x tp in one shard_map.
+
+Composition rules (extending ``flextree_tpu.parallel.train``):
+
+- ``ep`` is a *data* axis outside the MoE layers (the batch shards over
+  dp x ep jointly) and the *expert* axis inside them (tokens all-to-all to
+  their experts' owners) — the standard "expert parallelism reuses data
+  parallelism's devices" layout.
+- Expert weights shard over ep (leading expert axis) and tp (hidden dim),
+  so they sync only over the axes they're replicated on (dp, sp) — the
+  same replication-axes rule, driven by the MoE param specs.
+- The loss adds the router load-balance term: ``ce_mean +
+  router_aux_weight * aux_mean``, with the aux averaged over all devices.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.moe import MoEConfig, init_moe_params, moe_forward, moe_param_specs
+from ..models.transformer import cross_entropy_loss
+from .pipeline import factor_devices_4d, make_mesh_4d
+from .train import (
+    TrainConfig,
+    adamw_apply,
+    make_train_state,
+    resolve_axis_topos,
+    sync_grads,
+    validate_tp,
+)
+
+__all__ = [
+    "init_moe_train_state",
+    "moe_state_specs",
+    "make_moe_train_step",
+    "make_mesh_moe",
+    "factor_devices_moe",
+]
+
+
+def init_moe_train_state(key, cfg: MoEConfig) -> dict:
+    return make_train_state(init_moe_params(key, cfg))
+
+
+def moe_state_specs(
+    cfg: MoEConfig, tp_axis: str | None = "tp", ep_axis: str | None = "ep"
+) -> dict:
+    pspecs = moe_param_specs(cfg, tp_axis, ep_axis)
+    return {
+        "params": pspecs,
+        "mu": jax.tree.map(lambda s: s, pspecs),
+        "nu": jax.tree.map(lambda s: s, pspecs),
+        "step": P(),
+    }
+
+
+def factor_devices_moe(n: int) -> tuple[int, int, int, int]:
+    """(dp, ep, sp, tp) with ep covered first (8 -> (1, 2, 2, 2)) — the
+    same specialty-axis-first policy as the pipeline's 4-axis split."""
+    return factor_devices_4d(n)
+
+
+def make_mesh_moe(
+    n_devices: int | None = None,
+    shape: tuple[int, int, int, int] | None = None,
+    axis_names: tuple[str, str, str, str] = ("dp", "ep", "sp", "tp"),
+) -> Mesh:
+    return make_mesh_4d(n_devices, shape, axis_names)
+
+
+def make_moe_train_step(
+    mesh: Mesh,
+    model_cfg: MoEConfig,
+    train_cfg: TrainConfig = TrainConfig(),
+    axis_names: tuple[str, str, str, str] = ("dp", "ep", "sp", "tp"),
+):
+    """Jitted ``(state, tokens, targets) -> (state, metrics)``.
+
+    ``tokens``/``targets``: (B, T) int32, batch sharded over (dp, ep),
+    sequence over sp.  ``metrics``: global mean ``loss`` (cross entropy),
+    ``aux`` (router balance), and ``total`` (what is optimized).
+    """
+    dp, ep, sp, tp = axis_names
+    for a in axis_names:
+        if a not in mesh.shape:
+            raise ValueError(f"mesh is missing axis {a!r}; has {mesh.axis_names}")
+    ep_size, tp_size = mesh.shape[ep], mesh.shape[tp]
+    if model_cfg.n_experts % ep_size:
+        raise ValueError(
+            f"n_experts={model_cfg.n_experts} must be divisible by ep={ep_size}"
+        )
+    if model_cfg.top_k > model_cfg.n_experts:
+        raise ValueError("top_k cannot exceed n_experts")
+    validate_tp(model_cfg, tp_size)
+
+    sspecs = moe_state_specs(model_cfg, tp, ep)
+    data_spec = P((dp, ep), sp)
+    mesh_axes = axis_names
+    n_devices = 1
+    for a in mesh_axes:
+        n_devices *= mesh.shape[a]
+
+    def device_step(state, tokens, targets):
+        # tp-fold redundancy only: dp/ep/sp partition the data
+        n_total_tokens = (
+            tokens.size
+            * lax.axis_size(dp)
+            * lax.axis_size(ep)
+            * lax.axis_size(sp)
+            * lax.axis_size(tp)
+        )
+
+        def local_loss(params):
+            logits, aux = moe_forward(
+                params, tokens, model_cfg,
+                tp_axis=tp, sp_axis=sp, ep_axis=ep,
+            )
+            loss_sum, _ = cross_entropy_loss(logits, targets)
+            ce = loss_sum / n_total_tokens
+            # aux is a per-device mean; average it over every device (tp
+            # copies are redundant but identical, so the global mean is
+            # exact under the same 1/n_devices weighting)
+            aux_term = model_cfg.router_aux_weight * aux / n_devices
+            return ce + aux_term, (ce, aux)
+
+        (_, (ce, aux)), grads = jax.value_and_grad(local_loss, has_aux=True)(
+            state["params"]
+        )
+
+        topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
+        grads = sync_grads(grads, sspecs["params"], mesh_axes, topos)
+
+        global_ce = ce
+        global_aux = aux / n_devices
+        for ax in mesh_axes:
+            global_ce = lax.psum(global_ce, ax)
+            global_aux = lax.psum(global_aux, ax)
+
+        new_state = adamw_apply(state, grads, train_cfg)
+        metrics = {
+            "loss": global_ce,
+            "aux": global_aux,
+            "total": global_ce + model_cfg.router_aux_weight * global_aux,
+        }
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(sspecs, data_spec, data_spec),
+        out_specs=(sspecs, {"loss": P(), "aux": P(), "total": P()}),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -51,9 +51,11 @@ from .train import (
     TrainConfig,
     adamw_apply,
     make_mesh_nd,
+    make_train_state,
     resolve_axis_topos,
     spread_factors,
     sync_grads,
+    validate_tp,
 )
 
 __all__ = [
@@ -105,13 +107,7 @@ def pipeline_param_specs(
 
 
 def init_pipeline_train_state(key, cfg: TransformerConfig) -> dict:
-    params = stack_layer_params(init_params(key, cfg))
-    return {
-        "params": params,
-        "mu": jax.tree.map(jnp.zeros_like, params),
-        "nu": jax.tree.map(jnp.zeros_like, params),
-        "step": jnp.zeros((), jnp.int32),
-    }
+    return make_train_state(stack_layer_params(init_params(key, cfg)))
 
 
 def pipeline_state_specs(
@@ -245,14 +241,7 @@ def make_pipeline_train_step(
         raise ValueError(
             f"n_layers={model_cfg.n_layers} must be divisible by pp={pp_size}"
         )
-    tp_size = mesh.shape[tp]
-    if model_cfg.d_model % model_cfg.n_heads or model_cfg.n_heads % tp_size:
-        raise ValueError(
-            f"n_heads={model_cfg.n_heads} must divide d_model and be "
-            f"divisible by tp={tp_size}"
-        )
-    if model_cfg.d_ff % tp_size:
-        raise ValueError(f"d_ff={model_cfg.d_ff} must be divisible by tp={tp_size}")
+    validate_tp(model_cfg, mesh.shape[tp])
 
     sspecs = pipeline_state_specs(model_cfg, pp, tp)
     data_spec = P(dp, sp)

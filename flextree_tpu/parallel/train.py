@@ -34,7 +34,6 @@ exactly like the parameters, so optimizer memory scales down with TP.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import math
 from typing import Any
 
@@ -137,15 +136,31 @@ def make_mesh_3d(
     return make_mesh_nd(n_devices, shape, axis_names)
 
 
-def init_train_state(key, cfg: TransformerConfig) -> dict:
-    params = init_params(key, cfg)
-    zeros = jax.tree.map(jnp.zeros_like, params)
+def make_train_state(params) -> dict:
+    """Fresh AdamW state around a parameter pytree (any layout)."""
     return {
         "params": params,
-        "mu": zeros,
+        "mu": jax.tree.map(jnp.zeros_like, params),
         "nu": jax.tree.map(jnp.zeros_like, params),
         "step": jnp.zeros((), jnp.int32),
     }
+
+
+def validate_tp(model_cfg: TransformerConfig, tp_size: int) -> None:
+    """Shared precondition check for every train-step builder."""
+    if model_cfg.d_model % model_cfg.n_heads or model_cfg.n_heads % tp_size:
+        raise ValueError(
+            f"n_heads={model_cfg.n_heads} must divide d_model="
+            f"{model_cfg.d_model} and be divisible by tp={tp_size}"
+        )
+    if model_cfg.d_ff % tp_size:
+        raise ValueError(
+            f"d_ff={model_cfg.d_ff} must be divisible by tp={tp_size}"
+        )
+
+
+def init_train_state(key, cfg: TransformerConfig) -> dict:
+    return make_train_state(init_params(key, cfg))
 
 
 def state_specs(cfg: TransformerConfig, tp_axis: str | None = "tp") -> dict:
@@ -245,13 +260,7 @@ def make_train_step(
     for a in axis_names:
         if a not in mesh.shape:
             raise ValueError(f"mesh is missing axis {a!r}; has {mesh.axis_names}")
-    tp_size = mesh.shape[tp]
-    if model_cfg.d_model % (model_cfg.n_heads) or model_cfg.n_heads % tp_size:
-        raise ValueError(
-            f"n_heads={model_cfg.n_heads} must be divisible by tp={tp_size}"
-        )
-    if model_cfg.d_ff % tp_size:
-        raise ValueError(f"d_ff={model_cfg.d_ff} must be divisible by tp={tp_size}")
+    validate_tp(model_cfg, mesh.shape[tp])
 
     sspecs = state_specs(model_cfg, tp)
     data_spec = P(dp, sp)
