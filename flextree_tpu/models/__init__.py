@@ -14,6 +14,7 @@ from .transformer import (
     forward,
     init_params,
     layer_forward,
+    mlp_block,
     param_specs,
 )
 
@@ -23,6 +24,7 @@ __all__ = [
     "forward",
     "layer_forward",
     "attention_block",
+    "mlp_block",
     "init_params",
     "param_specs",
     "MoEConfig",

@@ -21,9 +21,9 @@ The framework's second model family (next to the dense
   ``tp``, row-parallel combine through the FlexTree allreduce), attention
   is the dense model's (ring/Ulysses sequence parallelism over ``sp``),
   so one MoE mesh runs dp x ep x sp x tp.
-- **Load balancing**: the Switch-style auxiliary loss ``E * mean_e(
-  token_frac_e * prob_mass_e)``, returned per layer and weighted into the
-  training loss by ``router_aux_weight``.
+- **Load balancing**: the Switch-style auxiliary loss ``E * sum_e(
+  token_frac_e * prob_mass_e)`` (1.0 at perfect balance), returned per
+  layer and weighted into the training loss by ``router_aux_weight``.
 
 Determinism note: routing is greedy argmax with first-come-first-served
 capacity slots (position = running count of earlier same-expert tokens), so

@@ -26,6 +26,7 @@ from .pipeline import factor_devices_4d, make_mesh_4d
 from .train import (
     TrainConfig,
     adamw_apply,
+    make_state_specs,
     make_train_state,
     resolve_axis_topos,
     sync_grads,
@@ -48,13 +49,7 @@ def init_moe_train_state(key, cfg: MoEConfig) -> dict:
 def moe_state_specs(
     cfg: MoEConfig, tp_axis: str | None = "tp", ep_axis: str | None = "ep"
 ) -> dict:
-    pspecs = moe_param_specs(cfg, tp_axis, ep_axis)
-    return {
-        "params": pspecs,
-        "mu": jax.tree.map(lambda s: s, pspecs),
-        "nu": jax.tree.map(lambda s: s, pspecs),
-        "step": P(),
-    }
+    return make_state_specs(moe_param_specs(cfg, tp_axis, ep_axis))
 
 
 def factor_devices_moe(n: int) -> tuple[int, int, int, int]:

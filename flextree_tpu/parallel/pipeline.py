@@ -51,6 +51,7 @@ from .train import (
     TrainConfig,
     adamw_apply,
     make_mesh_nd,
+    make_state_specs,
     make_train_state,
     resolve_axis_topos,
     spread_factors,
@@ -113,13 +114,7 @@ def init_pipeline_train_state(key, cfg: TransformerConfig) -> dict:
 def pipeline_state_specs(
     cfg: TransformerConfig, pp_axis: str | None = "pp", tp_axis: str | None = "tp"
 ) -> dict:
-    pspecs = pipeline_param_specs(cfg, pp_axis, tp_axis)
-    return {
-        "params": pspecs,
-        "mu": jax.tree.map(lambda s: s, pspecs),
-        "nu": jax.tree.map(lambda s: s, pspecs),
-        "step": P(),
-    }
+    return make_state_specs(pipeline_param_specs(cfg, pp_axis, tp_axis))
 
 
 # ------------------------------------------------------------- mesh helper

@@ -163,14 +163,13 @@ def init_train_state(key, cfg: TransformerConfig) -> dict:
     return make_train_state(init_params(key, cfg))
 
 
+def make_state_specs(pspecs) -> dict:
+    """Optimizer-state specs around parameter specs (moments shard alike)."""
+    return {"params": pspecs, "mu": pspecs, "nu": pspecs, "step": P()}
+
+
 def state_specs(cfg: TransformerConfig, tp_axis: str | None = "tp") -> dict:
-    pspecs = param_specs(cfg, tp_axis)
-    return {
-        "params": pspecs,
-        "mu": jax.tree.map(lambda s: s, pspecs),
-        "nu": jax.tree.map(lambda s: s, pspecs),
-        "step": P(),
-    }
+    return make_state_specs(param_specs(cfg, tp_axis))
 
 
 def _replication_axes(spec: P, mesh_axes) -> tuple[str, ...]:

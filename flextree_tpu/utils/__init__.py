@@ -1,10 +1,24 @@
-"""Utilities: timing, logging, profiling, and result-file conventions."""
+"""Utilities: timing, logging, profiling, checkpointing, result files."""
 
+from .checkpoint import (
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    restore_train_state,
+    save_checkpoint,
+    save_train_state,
+)
 from .logging import get_logger, result_file_name, write_result_file
 from .profiling import PhaseTimer, debug_dump_schedule, debug_enabled, phase_timer, trace
 from .timing import BenchResult, Timer, time_jax_fn
 
 __all__ = [
+    "save_checkpoint",
+    "restore_checkpoint",
+    "save_train_state",
+    "restore_train_state",
+    "latest_checkpoint",
+    "list_checkpoints",
     "get_logger",
     "result_file_name",
     "write_result_file",
