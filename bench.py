@@ -4,14 +4,19 @@
 
 Two modes, auto-selected:
 
-- **TPU attached** (the normal driver environment): benchmark the hot
-  compute path of the allreduce — the Pallas multi-source reduction kernel
-  (the rebuild of the reference's OpenMP ``reduce_sum``,
-  ``mpi_mod.hpp:246-452``) — against XLA's fused reduction of the same
-  stacked array.  Metric is achieved HBM bandwidth; ``vs_baseline`` is
-  ours/XLA.  (Only one TPU chip is attached, so the multi-chip allreduce
-  itself can't run on real hardware; its A/B lives in the CPU fallback and
-  in ``python -m flextree_tpu.bench``.)
+- **TPU attached** (the normal driver environment): benchmark the model
+  layer's hot op — the fused Pallas flash-attention kernel
+  (``flextree_tpu.ops.pallas_attention``) — against XLA's full-matrix
+  attention on identical bf16 inputs.  Metric is achieved TFLOP/s on the
+  causal-attention FLOPs; ``vs_baseline`` is ours/XLA (>1 = faster).
+  Timing chains each call's output into the next call's query and ends
+  with a host scalar fetch, so the device provably executes every step:
+  over the axon tunnel, per-call ``block_until_ready`` measures round-trip
+  latency on small work yet can return before long-running work finishes —
+  a data-dependency chain is the only timing this backend can't fake.  (Only one TPU chip is
+  attached, so the multi-chip allreduce itself can't run on real
+  hardware; its A/B lives in the CPU fallback and in
+  ``python -m flextree_tpu.bench``.)
 - **TPU unavailable / wedged**: the FlexTree allreduce vs ``lax.psum`` A/B
   on an 8-virtual-device CPU mesh (the reference's ``--comm-type`` A/B,
   ``benchmark.cpp:147-174``); metric is bus bandwidth, ``vs_baseline`` is
@@ -52,33 +57,69 @@ def tpu_alive(timeout_s: int = 120) -> bool:
         return False
 
 
+def _chained_s(fn, q, k, v, n_calls: int) -> float:
+    """Per-call seconds with each output fed back as the next query and a
+    final host scalar fetch — execution is forced by data dependency."""
+    import time
+
+    import jax.numpy as jnp
+
+    warm = fn(q, k, v)
+    float(jnp.sum(warm.astype(jnp.float32)))  # compile + forced warmup
+    t0 = time.perf_counter()
+    acc = q
+    for _ in range(n_calls):
+        acc = fn(acc, k, v)
+    float(jnp.sum(acc.astype(jnp.float32)))
+    return (time.perf_counter() - t0) / n_calls
+
+
 def bench_tpu_kernel() -> dict:
     import numpy as np
     import jax
     import jax.numpy as jnp
 
     sys.path.insert(0, REPO)
-    from flextree_tpu.ops.pallas_reduce import reduce_stacked, reduce_stacked_reference
-    from flextree_tpu.utils.timing import time_jax_fn
+    from flextree_tpu.ops.pallas_attention import flash_attention
+    from flextree_tpu.parallel.ring_attention import attention_reference
 
-    w, length = 8, 4 * 1024 * 1024  # 8 sources x 16 MB float32
+    b, t, h, d = 4, 4096, 16, 128
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((w, length)).astype(np.float32))
 
-    ours = time_jax_fn(
-        lambda v: reduce_stacked(v, op="sum", interpret=False), x, repeat=20
+    def mk():
+        return jnp.asarray(
+            rng.standard_normal((b, t, h, d)).astype(np.float32),
+            dtype=jnp.bfloat16,
+        )
+
+    q, k, v = mk(), mk(), mk()
+    flash = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=512, block_k=512, interpret=False
+        )
     )
-    baseline = time_jax_fn(
-        jax.jit(lambda v: reduce_stacked_reference(v, "sum")), x, repeat=20
-    )
-    nbytes = (w + 1) * length * 4  # read w copies + write one
-    ours_bw = nbytes / ours.min_s / 1e9
-    base_bw = nbytes / baseline.min_s / 1e9
+    ref = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
+
+    def flops_for(batch):
+        return 4 * batch * h * t * t * d / 2  # causal: half the score matrix
+
+    ours_s = _chained_s(flash, q, k, v, n_calls=30)
+    ours_tflops = flops_for(b) / ours_s / 1e12
+    # the full-matrix baseline materializes (B*H, T, T) f32 scores (~4 GB
+    # at these shapes); prefer the same batch for a like-for-like ratio,
+    # fall back to batch 1 on chips where that doesn't fit, comparing by
+    # achieved TFLOP/s either way
+    try:
+        base_s = _chained_s(ref, q, k, v, n_calls=10)
+        base_tflops = flops_for(b) / base_s / 1e12
+    except Exception:
+        base_s = _chained_s(ref, q[:1], k[:1], v[:1], n_calls=10)
+        base_tflops = flops_for(1) / base_s / 1e12
     return {
-        "metric": "pallas_multisource_reduce_hbm_bw",
-        "value": round(ours_bw, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(ours_bw / base_bw, 3),
+        "metric": "flash_attention_causal_bf16_tflops",
+        "value": round(ours_tflops, 2),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(ours_tflops / base_tflops, 3),
     }
 
 

@@ -40,7 +40,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.allreduce import allreduce
-from ..parallel.ring_attention import attention_reference, ring_attention
+from ..parallel.ring_attention import local_attention, ring_attention
 from ..parallel.ulysses import ulysses_attention
 
 __all__ = [
@@ -71,6 +71,10 @@ class TransformerConfig:
     # heads unconstrained) or "ulysses" (two all-to-alls, needs the local
     # head count divisible by the sp axis size)
     sp_impl: str = "ring"
+    # local attention compute: "reference" (jnp full-matrix) or "flash"
+    # (fused Pallas kernel, ops.pallas_attention) — applies wherever the
+    # full sequence is local (no sp axis, or the Ulysses inner attention)
+    attn_impl: str = "reference"
 
     @property
     def head_dim(self) -> int:
@@ -161,6 +165,8 @@ def _tp_combine(partial, tp_axis, cfg: TransformerConfig):
     return allreduce(partial, tp_axis, topo=cfg.tp_topo, op="sum")
 
 
+
+
 def attention_block(
     layer,
     x,
@@ -182,9 +188,9 @@ def attention_block(
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     if sp_axis is None:
-        attn = attention_reference(q, k, v, causal=True)
+        attn = local_attention(q, k, v, causal=True, impl=cfg.attn_impl)
     elif cfg.sp_impl == "ulysses":
-        attn = ulysses_attention(q, k, v, sp_axis, causal=True)
+        attn = ulysses_attention(q, k, v, sp_axis, causal=True, impl=cfg.attn_impl)
     elif cfg.sp_impl == "ring":
         attn = ring_attention(q, k, v, sp_axis, causal=True)
     else:

@@ -9,14 +9,20 @@ __all__ = [
     "get_op",
     "reduce_stacked",
     "reduce_stacked_reference",
+    "flash_attention",
+    "attention_with_offsets",
 ]
 
 
 def __getattr__(name):
-    # Lazy: the Pallas kernel pulls in JAX; keep the base op registry
+    # Lazy: the Pallas kernels pull in JAX; keep the base op registry
     # importable without it (the schedule layer stays JAX-free).
     if name in ("reduce_stacked", "reduce_stacked_reference"):
         from . import pallas_reduce
 
         return getattr(pallas_reduce, name)
+    if name in ("flash_attention", "attention_with_offsets"):
+        from . import pallas_attention
+
+        return getattr(pallas_attention, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,251 @@
+"""Pallas TPU kernel: fused causal flash attention (forward).
+
+The attention analog of ``pallas_reduce``: where that kernel pins the
+allreduce's local-reduce layout, this one fuses the model layer's hot op —
+the (Tq x Tk) score/softmax/value contraction — into a single VMEM-resident
+pass, so the T x T score matrix never touches HBM.  One grid step owns one
+(batch*head, q-block) tile; an inner ``fori_loop`` walks k/v blocks with
+the online-softmax running max / normalizer (the same accumulation scheme
+as ``flextree_tpu.parallel.ring_attention.local_attention_block``, but per
+128-row tile on the MXU instead of per ring hop).
+
+Causality is positional (``q_offset``/``k_offset`` give the blocks' global
+coordinates), so the kernel drops straight into the Ulysses path — after
+its all-to-all the full sequence is local — and into plain single-device
+attention; the causal upper bound also *shortens the k loop* per q tile,
+halving the work vs a masked dense matmul.
+
+Differentiable via ``jax.custom_vjp``: the backward recomputes attention
+with the pure-jnp oracle under ``jax.vjp``, so gradients are exact and the
+*forward* stores only (q, k, v) — but the recompute materializes the full
+(B*H, Tq, Tk) f32 score matrix, so **backward memory is O(T^2)** like the
+reference; the fused-forward memory win applies to inference and to
+sequence lengths whose score matrix still fits during training.  A
+blockwise flash backward kernel is the known next step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "attention_with_offsets"]
+
+_NEG_INF = -1e30
+
+
+def attention_with_offsets(
+    q, k, v, *, causal: bool, scale: float, q_offset=0, k_offset=0
+):
+    """Pure-jnp oracle on (BH, Tq, D)/(BH, Tk, D): full score matrix with
+    positional causal masking — the A/B reference and the VJP recompute."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None], s, _NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    if causal:
+        p = jnp.where(mask[None], p, 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    out = jnp.where(l > 0, out / jnp.where(l > 0, l, 1.0), 0.0)
+    return out.astype(q.dtype)
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    t_kv: int,
+    t_kv_valid: int,
+    causal: bool,
+    scale: float,
+    q_offset: int,
+    k_offset: int,
+):
+    i = pl.program_id(1)
+    q = q_ref[0]  # (bq, D), native dtype — bf16 q/k feed the MXU directly
+    d = q.shape[-1]
+    n_kb = t_kv // block_k
+
+    if causal:
+        # highest visible k position for this q tile (exclusive)
+        hi = q_offset + (i + 1) * block_q - k_offset
+        kb_hi = jnp.clip((hi + block_k - 1) // block_k, 0, n_kb)
+    else:
+        kb_hi = n_kb
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk) f32 scores from native-dtype operands
+        kpos = (
+            k_offset
+            + j * block_k
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        )
+        valid = kpos - k_offset < t_kv_valid
+        if causal:
+            qpos = (
+                q_offset
+                + i * block_q
+                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            valid = valid & (qpos >= kpos)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        # probabilities drop to v's dtype for the MXU (standard flash
+        # practice; exact when v is f32, ~1e-2 abs err in bf16)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, kb_hi, body, (m0, l0, acc0))
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+):
+    """(B, Tq, H, D) x (B, Tk, H, D)^2 -> (B, Tq, H, D) fused attention."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bq = min(block_q, max(tq, 8))
+    bk = min(block_k, max(tk, 8))
+    tq_pad = -(-tq // bq) * bq
+    tk_pad = -(-tk // bk) * bk
+
+    # (B, T, H, D) -> (B*H, T, D)
+    def to_bhd(x, t_pad):
+        x = x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+        if t_pad != x.shape[1]:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - x.shape[1]), (0, 0)))
+        return x
+
+    q3, k3, v3 = to_bhd(q, tq_pad), to_bhd(k, tk_pad), to_bhd(v, tk_pad)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_q=bq,
+            block_k=bk,
+            t_kv=tk_pad,
+            t_kv_valid=tk,
+            causal=causal,
+            scale=scale,
+            q_offset=q_offset,
+            k_offset=k_offset,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
+        grid=(b * h, tq_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        interpret=interpret,
+    )(q3, k3, v3)
+    out = out[:, :tq].reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return out
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+)
+def _flash_attention_core(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+):
+    return _flash_fwd_impl(
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    )
+
+
+def _core_fwd(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret):
+    out = _flash_fwd_impl(
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    )
+    return out, (q, k, v)
+
+
+def _core_bwd(causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    b, tq, h, d = q.shape
+
+    def ref(q, k, v):
+        def bhd(x):
+            return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+        out = attention_with_offsets(
+            bhd(q), bhd(k), bhd(v),
+            causal=causal, scale=scale,
+            q_offset=q_offset, k_offset=k_offset,
+        )
+        return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash_attention_core.defvjp(_core_fwd, _core_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    q_offset: int = 0,
+    k_offset: int = 0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Fused attention on (B, Tq, H, D) queries / (B, Tk, H, D) keys-values.
+
+    Same contract as ``attention_reference`` (output for the local queries
+    in ``q``'s dtype) plus global ``q_offset``/``k_offset`` positions for
+    causal masking of shifted blocks.  ``interpret=None`` auto-selects the
+    Pallas interpreter off-TPU so tests run on CPU.
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_attention_core(
+        q, k, v, causal, float(scale), int(q_offset), int(k_offset),
+        int(block_q), int(block_k), interpret,
+    )

@@ -15,7 +15,7 @@ from .launch import (
     topology_for_hybrid,
 )
 from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
-from .ring_attention import attention_reference, ring_attention
+from .ring_attention import attention_reference, local_attention, ring_attention
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
 
 __all__ = [
@@ -36,6 +36,7 @@ __all__ = [
     "topology_for_hybrid",
     "ring_attention",
     "attention_reference",
+    "local_attention",
     "ulysses_attention",
     "seq_to_heads",
     "heads_to_seq",

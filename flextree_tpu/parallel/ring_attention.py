@@ -28,7 +28,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["ring_attention", "attention_reference", "local_attention_block"]
+__all__ = [
+    "ring_attention",
+    "attention_reference",
+    "local_attention",
+    "local_attention_block",
+]
 
 _NEG_INF = -1e30
 
@@ -124,6 +129,21 @@ def _finalize(acc, l):
     (possible only for non-causal edge cases) yield zeros, not NaNs."""
     denom = l.transpose(0, 2, 1)[..., None]
     return jnp.where(denom > 0, acc / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def local_attention(q, k, v, *, causal: bool = True,
+                    scale: float | None = None, impl: str = "reference"):
+    """Full-sequence-local attention, dispatched by implementation name:
+    "reference" (jnp full matrix) or "flash" (the fused Pallas kernel,
+    ``flextree_tpu.ops.pallas_attention``) — the single switch shared by
+    the model forward and the Ulysses inner attention."""
+    if impl == "flash":
+        from ..ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "reference":
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")
 
 
 def attention_reference(q, k, v, *, causal: bool = True,

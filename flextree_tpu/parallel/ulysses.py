@@ -27,7 +27,7 @@ from __future__ import annotations
 import jax
 from jax import lax
 
-from .ring_attention import attention_reference
+from .ring_attention import local_attention
 
 __all__ = ["ulysses_attention", "seq_to_heads", "heads_to_seq"]
 
@@ -59,7 +59,7 @@ def heads_to_seq(x, axis_name):
 
 
 def ulysses_attention(q, k, v, axis_name, *, causal: bool = True,
-                      scale: float | None = None):
+                      scale: float | None = None, impl: str = "reference"):
     """Exact attention with sequence sharded over ``axis_name``.
 
     Same contract as ``ring_attention``: ``q``/``k``/``v`` are
@@ -68,12 +68,17 @@ def ulysses_attention(q, k, v, axis_name, *, causal: bool = True,
     output for the local queries in ``q``'s dtype.  Requires ``H`` divisible
     by the axis size.  Causality falls out naturally: after the re-shard the
     full sequence is local, so the plain causal mask is already global.
+
+    ``impl``: the local attention compute — "reference" (jnp full matrix)
+    or "flash" (the fused Pallas kernel, ``ops.pallas_attention``; the
+    enclosing ``shard_map`` must pass ``check_vma=False`` because
+    ``pallas_call`` outputs carry no varying-mesh-axes type).
     """
     with jax.named_scope("ulysses_seq2head"):
         qh = seq_to_heads(q, axis_name)
         kh = seq_to_heads(k, axis_name)
         vh = seq_to_heads(v, axis_name)
     with jax.named_scope("ulysses_local_attn"):
-        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        out = local_attention(qh, kh, vh, causal=causal, scale=scale, impl=impl)
     with jax.named_scope("ulysses_head2seq"):
         return heads_to_seq(out, axis_name)

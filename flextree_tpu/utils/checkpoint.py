@@ -47,6 +47,12 @@ _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 def _encode(tree, leaves: list):
     """Replace leaves with indices into ``leaves``; keep container shape."""
     if isinstance(tree, dict):
+        for key in tree:
+            if not isinstance(key, str):
+                raise TypeError(
+                    f"checkpoint dict keys must be strings (JSON structure "
+                    f"descriptor); got {key!r} ({type(key).__name__})"
+                )
         return {"t": "dict", "items": {k: _encode(v, leaves) for k, v in tree.items()}}
     if isinstance(tree, (list, tuple)):
         kind = "list" if isinstance(tree, list) else "tuple"

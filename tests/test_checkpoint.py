@@ -145,3 +145,8 @@ def test_sharded_restore_requires_specs(tmp_path):
     mesh = make_mesh_3d(1, (1, 1, 1))
     with pytest.raises(ValueError, match="specs"):
         restore_checkpoint(path, mesh=mesh)
+
+
+def test_non_string_dict_keys_fail_fast(tmp_path):
+    with pytest.raises(TypeError, match="strings"):
+        save_checkpoint(tmp_path / "x.npz", {0: np.zeros(2)})

@@ -1,0 +1,164 @@
+"""Pallas flash attention vs the jnp oracle (interpret mode on CPU).
+
+Same discipline as test_pallas_reduce: every kernel configuration must
+match the full-matrix reference bit-for-tolerance, including the edge
+geometry (non-divisible sequence lengths, offsets, cross-attention).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flextree_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    param_specs,
+)
+from flextree_tpu.ops.pallas_attention import (
+    attention_with_offsets,
+    flash_attention,
+)
+from flextree_tpu.parallel.ring_attention import attention_reference
+from flextree_tpu.parallel.ulysses import ulysses_attention
+
+
+def _qkv(b=2, t=48, h=4, d=16, tk=None, seed=0):
+    rng = np.random.default_rng(seed)
+    tk = t if tk is None else tk
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, tk, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, tk, h, d)).astype(np.float32))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t", [16, 48, 100])  # 100: needs tail padding
+def test_flash_matches_reference(causal, t):
+    q, k, v = _qkv(t=t)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_cross_attention_lengths():
+    q, k, v = _qkv(t=32, tk=80)
+    out = flash_attention(q, k, v, causal=False, block_q=16, block_k=32)
+    ref = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_offsets_match_oracle():
+    """Shifted blocks: q block at global 64, k block at global 0."""
+    b, h, d = 2, 4, 16
+    q, k, v = _qkv(b=b, t=32, tk=64, h=h, d=d)
+
+    def bhd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    out = flash_attention(
+        q, k, v, causal=True, q_offset=64, k_offset=0, block_q=16, block_k=16
+    )
+    ref = attention_with_offsets(
+        bhd(q), bhd(k), bhd(v),
+        causal=True, scale=1.0 / d**0.5, q_offset=64, k_offset=0,
+    ).reshape(b, h, 32, d).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_fully_masked_rows_are_zero():
+    """q strictly before k (causal): every row masked -> zeros, no NaN."""
+    q, k, v = _qkv(t=16, tk=16)
+    out = flash_attention(
+        q, k, v, causal=True, q_offset=0, k_offset=100, block_q=16, block_k=16
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.zeros_like(np.asarray(out)))
+
+
+def test_flash_gradients_match_reference():
+    q, k, v = _qkv(t=32)
+    g_f = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, block_q=16, block_k=16) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_r = jax.grad(
+        lambda q, k, v: (attention_reference(q, k, v, causal=True) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_bf16_close_to_f32():
+    q, k, v = _qkv(t=32)
+    ref = attention_reference(q, k, v, causal=True)
+    out = flash_attention(
+        q.astype(jnp.bfloat16),
+        k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16),
+        block_q=16,
+        block_k=16,
+    )
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.12
+    )
+
+
+def test_flash_rejects_bad_shapes():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="B, T, H, D"):
+        flash_attention(q[0], k[0], v[0])
+    with pytest.raises(ValueError, match="differ"):
+        flash_attention(q, k[:, :16], v)
+
+
+# ---------------------------------------------------------- model plumbing
+
+
+def test_forward_flash_matches_reference_impl():
+    cfg_r = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    cfg_f = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        attn_impl="flash",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg_r)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+    ref = forward(params, tokens, cfg_r)
+    out = forward(params, tokens, cfg_f)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ulysses_flash_matches_reference():
+    mesh = jax.make_mesh((4,), ("sp",))
+    q, k, v = _qkv(t=64, h=8)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, "sp", impl="flash"),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            # pallas_call can't declare vma types; skip the static check
+            check_vma=False,
+        )
+    )
+    out = fn(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ulysses_unknown_impl_raises():
+    mesh = jax.make_mesh((2,), ("sp",))
+    q, k, v = _qkv(t=32, h=4)
+    with pytest.raises(ValueError, match="impl"):
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, "sp", impl="nope"),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+        )(q, k, v)
