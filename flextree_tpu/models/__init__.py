@@ -1,5 +1,6 @@
 """Model substrate the collectives serve: dense transformer LM + MoE LM."""
 
+from .generate import decode_step, generate, init_kv_cache, prefill
 from .moe import (
     MoEConfig,
     init_moe_params,
@@ -32,4 +33,8 @@ __all__ = [
     "moe_forward",
     "moe_layer",
     "moe_param_specs",
+    "generate",
+    "prefill",
+    "decode_step",
+    "init_kv_cache",
 ]

@@ -1,0 +1,157 @@
+"""Autoregressive generation with a static KV cache.
+
+Completes the dense model family's serving path: prefill runs the full
+forward once while recording every layer's K/V; decode then advances one
+token at a time, attending over the cache.  Everything is static-shaped
+for XLA: the cache is allocated at ``max_len`` up front, the causal bound
+is a mask on cached positions (not a dynamic slice), and the decode loop
+is a ``lax.scan`` — so the whole ``generate`` call jits to two compiled
+programs (prefill + scanned decode) regardless of token count.
+
+Single-device by design: generation is latency-bound, and the framework's
+sharded story lives in the training steps; a tp-sharded decode would reuse
+the same cache layout with heads split over the axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import (
+    TransformerConfig,
+    apply_rope,
+    mlp_block,
+    rms_norm,
+)
+
+__all__ = ["init_kv_cache", "prefill", "decode_step", "generate"]
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
+    """Per-layer (B, max_len, H, Dh) K/V buffers in the compute dtype."""
+    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _qkv(layer, h, cfg: TransformerConfig):
+    b, t = h.shape[:2]
+    shape = (b, t, cfg.n_heads, cfg.head_dim)
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(shape)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(shape)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(shape)
+    return q, k, v
+
+
+def _cached_attention(q, k_cache, v_cache, q_pos):
+    """Attend (B, Tq, H, D) queries over cached positions ``<= q_pos``
+    (global query positions); the causal bound alone masks out every
+    not-yet-written cache slot.  Math order mirrors ``attention_reference``
+    exactly (einsum in the compute dtype, then f32) so decode logits are
+    teacher-forcing-exact in every dtype."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) * scale
+    kpos = jnp.arange(k_cache.shape[1])
+    mask = kpos[None, :] <= q_pos[:, None]
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _forward_cached(params, tokens, cache, start_pos, cfg: TransformerConfig):
+    """Forward ``tokens`` (B, T) writing K/V at ``start_pos..start_pos+T``;
+    returns (logits, cache).  ``start_pos`` may be traced (decode)."""
+    b, t = tokens.shape
+    positions = start_pos + jnp.arange(t)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    new_k, new_v = [], []
+    for layer, kc, vc in zip(params["layers"], cache["k"], cache["v"]):
+        h = rms_norm(x, layer["ln1"])
+        q, k, v = _qkv(layer, h, cfg)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        kc = lax.dynamic_update_slice_in_dim(kc, k, start_pos, axis=1)
+        vc = lax.dynamic_update_slice_in_dim(vc, v, start_pos, axis=1)
+        new_k.append(kc)
+        new_v.append(vc)
+        attn = _cached_attention(q, kc, vc, positions)
+        o = attn.reshape(b, t, -1) @ layer["wo"].astype(cfg.dtype)
+        x = x + o
+        x = mlp_block(layer, x, cfg)
+    x = rms_norm(x, params["ln_f"])
+    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    cache = {"k": new_k, "v": new_v, "length": start_pos + t}
+    return logits, cache
+
+
+def prefill(params, tokens, cfg: TransformerConfig, max_len: int):
+    """Run the prompt through the model once.  Returns
+    ``(last_logits, cache)`` with the cache filled for ``tokens``."""
+    b, t = tokens.shape
+    if t > max_len:
+        raise ValueError(f"prompt length {t} exceeds max_len {max_len}")
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = _forward_cached(params, tokens, cache, 0, cfg)
+    return logits[:, -1], cache
+
+
+def decode_step(params, cache, token, cfg: TransformerConfig):
+    """One decode step: ``token`` (B,) int32 at position ``cache['length']``.
+    Returns ``(logits, cache)`` for the next position."""
+    logits, cache = _forward_cached(
+        params, token[:, None], cache, cache["length"], cfg
+    )
+    return logits[:, 0], cache
+
+
+def generate(
+    params,
+    prompt,
+    cfg: TransformerConfig,
+    *,
+    max_new_tokens: int,
+    max_len: int | None = None,
+    temperature: float = 0.0,
+    key=None,
+):
+    """Greedy (``temperature=0``) or sampled continuation of ``prompt``
+    (B, T) int32 -> (B, max_new_tokens) int32.  Sampling requires an
+    explicit ``key``."""
+    b, t = prompt.shape
+    if max_len is None:
+        max_len = t + max_new_tokens
+    if t + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({t}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len ({max_len})"
+        )
+    sampling = temperature > 0
+    if sampling and key is None:
+        raise ValueError("temperature > 0 requires an explicit key=")
+
+    logits, cache = prefill(params, prompt, cfg, max_len)
+
+    def pick(logits, k):
+        if not sampling:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(k, logits / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    def step(carry, k):
+        logits, cache = carry
+        tok = pick(logits, k)
+        logits, cache = decode_step(params, cache, tok, cfg)
+        return (logits, cache), tok
+
+    xs = jax.random.split(key, max_new_tokens) if sampling else None
+    (_, _), toks = lax.scan(
+        step, (logits, cache), xs, length=None if sampling else max_new_tokens
+    )
+    return toks.T  # (B, max_new_tokens)
