@@ -144,14 +144,19 @@ def generate(
             jnp.int32
         )
 
-    def step(carry, k):
-        logits, cache = carry
-        tok = pick(logits, k)
-        logits, cache = decode_step(params, cache, tok, cfg)
-        return (logits, cache), tok
+    keys = jax.random.split(key, max_new_tokens) if sampling else None
+    # first token comes straight from the prefill logits; the scan then
+    # decodes exactly max_new_tokens - 1 times (no trailing wasted forward)
+    tok0 = pick(logits, keys[0] if sampling else None)
 
-    xs = jax.random.split(key, max_new_tokens) if sampling else None
-    (_, _), toks = lax.scan(
-        step, (logits, cache), xs, length=None if sampling else max_new_tokens
+    def step(carry, k):
+        tok, cache = carry
+        logits, cache = decode_step(params, cache, tok, cfg)
+        nxt = pick(logits, k)
+        return (nxt, cache), nxt
+
+    xs = keys[1:] if sampling else None
+    (_, _), rest = lax.scan(
+        step, (tok0, cache), xs, length=None if sampling else max_new_tokens - 1
     )
-    return toks.T  # (B, max_new_tokens)
+    return jnp.concatenate([tok0[:, None], rest.T], axis=1)

@@ -15,13 +15,13 @@ its all-to-all the full sequence is local — and into plain single-device
 attention; the causal upper bound also *shortens the k loop* per q tile,
 halving the work vs a masked dense matmul.
 
-Differentiable via ``jax.custom_vjp``: the backward recomputes attention
-with the pure-jnp oracle under ``jax.vjp``, so gradients are exact and the
-*forward* stores only (q, k, v) — but the recompute materializes the full
-(B*H, Tq, Tk) f32 score matrix, so **backward memory is O(T^2)** like the
-reference; the fused-forward memory win applies to inference and to
-sequence lengths whose score matrix still fits during training.  A
-blockwise flash backward kernel is the known next step.
+Differentiable via ``jax.custom_vjp`` with a **blockwise flash backward**:
+the forward additionally emits the per-row logsumexp, and two backward
+kernels recompute probabilities tile-by-tile from (q, k, v, lse) — one
+gridded over q tiles producing dq, one over k tiles producing dk/dv — so
+the backward, like the forward, never materializes the (Tq, Tk) score
+matrix.  Total residual memory is O(T) beyond the inputs (out + lse +
+delta rows).
 """
 
 from __future__ import annotations
@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 __all__ = ["flash_attention", "attention_with_offsets"]
 
 _NEG_INF = -1e30
+_LANE = 128  # lse is lane-replicated to satisfy Mosaic's (8, 128) block rule
 
 
 def attention_with_offsets(
@@ -65,7 +66,7 @@ def _flash_kernel(
     k_ref,
     v_ref,
     o_ref,
-    *,
+    *maybe_lse_ref,
     block_q: int,
     block_k: int,
     t_kv: int,
@@ -128,32 +129,58 @@ def _flash_kernel(
     m, l, acc = lax.fori_loop(0, kb_hi, body, (m0, l0, acc0))
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
+    if maybe_lse_ref:  # only the differentiated path pays for the lse store
+        # fully-masked rows get a +inf-like sentinel so the backward's
+        # exp(s - lse) is exactly zero for them; the value is replicated
+        # across the 128-lane minor dim (Mosaic block constraint)
+        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-38)), -_NEG_INF)
+        maybe_lse_ref[0][0] = jnp.broadcast_to(lse, (block_q, _LANE))
+
+
+def _blocks(q, k, block_q, block_k):
+    """Resolved (bq, bk, tq_pad, tk_pad, interpret-independent) geometry."""
+    tq, tk = q.shape[1], k.shape[1]
+    bq = min(block_q, max(tq, 8))
+    bk = min(block_k, max(tk, 8))
+    return bq, bk, -(-tq // bq) * bq, -(-tk // bk) * bk
+
+
+def _to_bhd(x, t_pad):
+    """(B, T, H, D) -> (B*H, T_pad, D)."""
+    b, t, h, d = x.shape
+    x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    if t_pad != t:
+        x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+    return x
+
+
+def _from_bhd(x, b, h, t):
+    return x[:, :t].reshape(b, h, t, x.shape[-1]).transpose(0, 2, 1, 3)
 
 
 def _flash_fwd_impl(
-    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+    emit_lse: bool = False,
 ):
-    """(B, Tq, H, D) x (B, Tk, H, D)^2 -> (B, Tq, H, D) fused attention."""
+    """(B, Tq, H, D) x (B, Tk, H, D)^2 -> fused attention out, plus the
+    per-row logsumexp (B*H, Tq_pad) when ``emit_lse`` (else None) — the
+    primal/inference path skips that extra HBM store entirely."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    bq, bk, tq_pad, tk_pad = _blocks(q, k, block_q, block_k)
+    q3, k3, v3 = _to_bhd(q, tq_pad), _to_bhd(k, tk_pad), _to_bhd(v, tk_pad)
 
-    bq = min(block_q, max(tq, 8))
-    bk = min(block_k, max(tk, 8))
-    tq_pad = -(-tq // bq) * bq
-    tk_pad = -(-tk // bk) * bk
+    out_shape = [jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0))]
+    if emit_lse:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, tq_pad, _LANE), jnp.float32)
+        )
+        out_specs.append(pl.BlockSpec((1, bq, _LANE), lambda bh, i: (bh, i, 0)))
 
-    # (B, T, H, D) -> (B*H, T, D)
-    def to_bhd(x, t_pad):
-        x = x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-        if t_pad != x.shape[1]:
-            x = jnp.pad(x, ((0, 0), (0, t_pad - x.shape[1]), (0, 0)))
-        return x
-
-    q3, k3, v3 = to_bhd(q, tq_pad), to_bhd(k, tk_pad), to_bhd(v, tk_pad)
-
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         functools.partial(
             _flash_kernel,
             block_q=bq,
@@ -165,18 +192,205 @@ def _flash_fwd_impl(
             q_offset=q_offset,
             k_offset=k_offset,
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
+        out_shape=tuple(out_shape),
         grid=(b * h, tq_pad // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        out_specs=tuple(out_specs),
         interpret=interpret,
     )(q3, k3, v3)
-    out = out[:, :tq].reshape(b, h, tq, d).transpose(0, 2, 1, 3)
-    return out
+    if emit_lse:
+        out, lse = res
+        # store only one lane's row as the residual (128x smaller); the
+        # backward re-broadcasts to the block layout on entry
+        return _from_bhd(out, b, h, tq), lse[..., 0]
+    return _from_bhd(res[0], b, h, tq), None
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
+    block_q, block_k, t_kv, t_kv_valid, causal, scale, q_offset, k_offset,
+):
+    i = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0:1]  # (bq, 1) — lane-replicated storage
+    # delta_i = dout_i . out_i (the softmax-normalizer term)
+    delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
+    d = q.shape[-1]
+    n_kb = t_kv // block_k
+    if causal:
+        hi = q_offset + (i + 1) * block_q - k_offset
+        kb_hi = jnp.clip((hi + block_k - 1) // block_k, 0, n_kb)
+    else:
+        kb_hi = n_kb
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        kpos = (
+            k_offset + j * block_k
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        )
+        valid = kpos - k_offset < t_kv_valid
+        if causal:
+            qpos = (
+                q_offset + i * block_q
+                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            valid = valid & (qpos >= kpos)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, vb.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = lax.fori_loop(0, kb_hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref, *,
+    block_q, block_k, t_q, t_kv_valid, causal, scale, q_offset, k_offset,
+):
+    j = pl.program_id(1)
+    kb = k_ref[0]
+    vb = v_ref[0]
+    d = kb.shape[-1]
+    n_qb = t_q // block_q
+    if causal:
+        # first q tile whose last row can see this k tile
+        lo = (k_offset + j * block_k - q_offset) // block_q
+        qb_lo = jnp.clip(lo, 0, n_qb)
+    else:
+        qb_lo = 0
+
+    kpos = (
+        k_offset + j * block_k
+        + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    )
+    k_valid = kpos - k_offset < t_kv_valid
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        ob = o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]  # (bq, 1)
+        delta = jnp.sum(do * ob, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        valid = k_valid
+        if causal:
+            qpos = (
+                q_offset + i * block_q
+                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            valid = valid & (qpos >= kpos)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(qb_lo, n_qb, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(
+    q, k, v, out, lse, g, causal, scale, q_offset, k_offset,
+    block_q, block_k, interpret,
+):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq, bk, tq_pad, tk_pad = _blocks(q, k, block_q, block_k)
+    q3, k3, v3 = _to_bhd(q, tq_pad), _to_bhd(k, tk_pad), _to_bhd(v, tk_pad)
+    do3 = _to_bhd(g, tq_pad)
+    o3 = _to_bhd(out, tq_pad)
+    # residual lse is one row per query; rebuild the lane-replicated block
+    # layout the kernels read ([:, 0:1])
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANE))
+
+    common = dict(
+        block_q=bq, block_k=bk, causal=causal, scale=scale,
+        q_offset=q_offset, k_offset=k_offset,
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, t_kv=tk_pad, t_kv_valid=tk, **common
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
+        grid=(b * h, tq_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda bh, i: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        interpret=interpret,
+    )(q3, k3, v3, do3, o3, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, t_q=tq_pad, t_kv_valid=tk, **common
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, tk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk_pad, d), v.dtype),
+        ),
+        grid=(b * h, tk_pad // bk),
+        in_specs=[
+            pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, tq_pad, _LANE), lambda bh, j: (bh, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do3, o3, lse)
+
+    return (
+        _from_bhd(dq, b, h, tq),
+        _from_bhd(dk, b, h, tk),
+        _from_bhd(dv, b, h, tk),
+    )
 
 
 @functools.partial(
@@ -185,35 +399,26 @@ def _flash_fwd_impl(
 def _flash_attention_core(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
 ):
-    return _flash_fwd_impl(
+    out, _ = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
     )
+    return out
 
 
 def _core_fwd(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret):
-    out = _flash_fwd_impl(
-        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+        emit_lse=True,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _core_bwd(causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    b, tq, h, d = q.shape
-
-    def ref(q, k, v):
-        def bhd(x):
-            return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-        out = attention_with_offsets(
-            bhd(q), bhd(k), bhd(v),
-            causal=causal, scale=scale,
-            q_offset=q_offset, k_offset=k_offset,
-        )
-        return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g, causal, scale, q_offset, k_offset,
+        block_q, block_k, interpret,
+    )
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)
