@@ -192,7 +192,7 @@ def attention_block(
     elif cfg.sp_impl == "ulysses":
         attn = ulysses_attention(q, k, v, sp_axis, causal=True, impl=cfg.attn_impl)
     elif cfg.sp_impl == "ring":
-        attn = ring_attention(q, k, v, sp_axis, causal=True)
+        attn = ring_attention(q, k, v, sp_axis, causal=True, impl=cfg.attn_impl)
     else:
         raise ValueError(f"unknown sp_impl {cfg.sp_impl!r}")
     o = attn.reshape(b, t_local, -1) @ layer["wo"].astype(cfg.dtype)

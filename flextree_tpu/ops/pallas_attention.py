@@ -211,13 +211,17 @@ def _flash_fwd_impl(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
-    block_q, block_k, t_kv, t_kv_valid, causal, scale, q_offset, k_offset,
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
+    has_glse, block_q, block_k, t_kv, t_kv_valid, causal, scale,
+    q_offset, k_offset,
 ):
+    dq_ref = rest[-1]
     i = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, 0:1]  # (bq, 1) — lane-replicated storage
+    # cotangent of the lse output; operand only exists when it was consumed
+    glse = rest[0][0][:, 0:1] if has_glse else 0.0
     # delta_i = dout_i . out_i (the softmax-normalizer term)
     delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
     d = q.shape[-1]
@@ -251,7 +255,8 @@ def _flash_bwd_dq_kernel(
             do, vb.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        # d(lse_i)/d(s_ij) = p_ij, so the lse cotangent adds glse_i * p_ij
+        ds = p * (dp - delta + glse)
         return dq + jax.lax.dot_general(
             ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -262,9 +267,12 @@ def _flash_bwd_dq_kernel(
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref, *,
-    block_q, block_k, t_q, t_kv_valid, causal, scale, q_offset, k_offset,
+    q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
+    has_glse, block_q, block_k, t_q, t_kv_valid, causal, scale,
+    q_offset, k_offset,
 ):
+    glse_ref = rest[0] if has_glse else None
+    dk_ref, dv_ref = rest[-2], rest[-1]
     j = pl.program_id(1)
     kb = k_ref[0]
     vb = v_ref[0]
@@ -289,6 +297,9 @@ def _flash_bwd_dkv_kernel(
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         ob = o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]  # (bq, 1)
+        glse = (
+            glse_ref[0, pl.ds(i * block_q, block_q), 0:1] if has_glse else 0.0
+        )
         delta = jnp.sum(do * ob, axis=-1, keepdims=True)
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
@@ -310,7 +321,7 @@ def _flash_bwd_dkv_kernel(
             do, vb.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)
+        ds = p * (dp - delta + glse)
         dk = dk + jax.lax.dot_general(
             ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -325,9 +336,11 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_impl(
-    q, k, v, out, lse, g, causal, scale, q_offset, k_offset,
+    q, k, v, out, lse, g, g_lse, causal, scale, q_offset, k_offset,
     block_q, block_k, interpret,
 ):
+    """``g``: cotangent of the attention output; ``g_lse``: cotangent of
+    the lse output ((B*H, Tq_pad) or None when lse was not consumed)."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if interpret is None:
@@ -339,29 +352,51 @@ def _flash_bwd_impl(
     # residual lse is one row per query; rebuild the lane-replicated block
     # layout the kernels read ([:, 0:1])
     lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANE))
+    has_glse = g_lse is not None
+    dq_inputs = [q3, k3, v3, do3, o3, lse]
+    if has_glse:
+        g_lse = jnp.broadcast_to(
+            g_lse.astype(jnp.float32)[..., None], lse.shape
+        )
+        dq_inputs.append(g_lse)
 
     common = dict(
-        block_q=bq, block_k=bk, causal=causal, scale=scale,
-        q_offset=q_offset, k_offset=k_offset,
+        has_glse=has_glse, block_q=bq, block_k=bk, causal=causal,
+        scale=scale, q_offset=q_offset, k_offset=k_offset,
     )
+    dq_tile_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, bq, _LANE), lambda bh, i: (bh, i, 0)),
+    ]
+    if has_glse:
+        dq_tile_specs.append(pl.BlockSpec((1, bq, _LANE), lambda bh, i: (bh, i, 0)))
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, t_kv=tk_pad, t_kv_valid=tk, **common
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype),
         grid=(b * h, tq_pad // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda bh, i: (bh, i, 0)),
-        ],
+        in_specs=dq_tile_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
         interpret=interpret,
-    )(q3, k3, v3, do3, o3, lse)
+    )(*dq_inputs)
 
+    dkv_specs = [
+        pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+        pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, tq_pad, _LANE), lambda bh, j: (bh, 0, 0)),
+    ]
+    if has_glse:
+        dkv_specs.append(
+            pl.BlockSpec((1, tq_pad, _LANE), lambda bh, j: (bh, 0, 0))
+        )
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, t_q=tq_pad, t_kv_valid=tk, **common
@@ -371,20 +406,13 @@ def _flash_bwd_impl(
             jax.ShapeDtypeStruct((b * h, tk_pad, d), v.dtype),
         ),
         grid=(b * h, tk_pad // bk),
-        in_specs=[
-            pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, tq_pad, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, tq_pad, _LANE), lambda bh, j: (bh, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=(
             pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
         ),
         interpret=interpret,
-    )(q3, k3, v3, do3, o3, lse)
+    )(*dq_inputs)
 
     return (
         _from_bhd(dq, b, h, tq),
@@ -416,12 +444,72 @@ def _core_fwd(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, inte
 def _core_bwd(causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     return _flash_bwd_impl(
-        q, k, v, out, lse, g, causal, scale, q_offset, k_offset,
+        q, k, v, out, lse, g, None, causal, scale, q_offset, k_offset,
         block_q, block_k, interpret,
     )
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)
+
+
+# -- variant exposing a differentiable logsumexp output (ring-merge input) --
+
+
+def _lse_to_btH(lse, b, h, t):
+    """(B*H, Tq_pad) row layout -> (B, Tq, H), sentinel -> -inf-like."""
+    out = lse[:, :t].reshape(b, h, t).transpose(0, 2, 1)
+    # in-kernel sentinel for fully-masked rows is +1e30 (so the backward's
+    # exp(s - lse) vanishes); the public meaning is "no mass" = -inf-like
+    return jnp.where(out >= -_NEG_INF, _NEG_INF, out)
+
+
+def _lse_from_btH(g_lse, tq_pad):
+    """(B, Tq, H) cotangent -> (B*H, Tq_pad) row layout."""
+    b, t, h = g_lse.shape
+    g = g_lse.transpose(0, 2, 1).reshape(b * h, t)
+    if tq_pad != t:
+        g = jnp.pad(g, ((0, 0), (0, tq_pad - t)))
+    return g
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+)
+def _flash_attention_lse_core(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+):
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+        emit_lse=True,
+    )
+    b, tq, h, _ = q.shape
+    return out, _lse_to_btH(lse, b, h, tq)
+
+
+def _lse_core_fwd(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+):
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+        emit_lse=True,
+    )
+    b, tq, h, _ = q.shape
+    return (out, _lse_to_btH(lse, b, h, tq)), (q, k, v, out, lse)
+
+
+def _lse_core_bwd(
+    causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g
+):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    tq_pad = lse.shape[1]
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g_out, _lse_from_btH(g_lse, tq_pad),
+        causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+    )
+
+
+_flash_attention_lse_core.defvjp(_lse_core_fwd, _lse_core_bwd)
 
 
 def flash_attention(
@@ -436,6 +524,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    return_lse: bool = False,
 ):
     """Fused attention on (B, Tq, H, D) queries / (B, Tk, H, D) keys-values.
 
@@ -443,6 +532,11 @@ def flash_attention(
     in ``q``'s dtype) plus global ``q_offset``/``k_offset`` positions for
     causal masking of shifted blocks.  ``interpret=None`` auto-selects the
     Pallas interpreter off-TPU so tests run on CPU.
+
+    With ``return_lse=True`` also returns the per-row logsumexp of the
+    masked scores, shape (B, Tq, H) float32 (fully-masked rows: -1e30) —
+    differentiable, which is what lets blockwise consumers (the flash ring
+    attention) merge partial attentions exactly.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
@@ -450,7 +544,8 @@ def flash_attention(
         raise ValueError(f"k/v shapes differ: {k.shape} vs {v.shape}")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _flash_attention_core(
+    core = _flash_attention_lse_core if return_lse else _flash_attention_core
+    return core(
         q, k, v, causal, float(scale), int(q_offset), int(k_offset),
         int(block_q), int(block_k), interpret,
     )

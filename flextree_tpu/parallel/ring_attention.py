@@ -67,7 +67,7 @@ def local_attention_block(q, k, v, q_pos, k_pos, *, causal: bool, scale: float,
 
 
 def ring_attention(q, k, v, axis_name, *, causal: bool = True,
-                   scale: float | None = None):
+                   scale: float | None = None, impl: str = "reference"):
     """Exact attention with sequence sharded over ``axis_name``.
 
     ``q``/``k``/``v``: (B, T_local, H, D) — this device's sequence shard; the
@@ -82,7 +82,16 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = True,
     ``mpi_mod.hpp:1145-1146``).  Causality is enforced with global positions,
     so blocks strictly in the future contribute nothing (they still traverse
     the ring: uniform steps keep the program SPMD and the schedule static).
+
+    ``impl``: the per-hop block compute — "reference" (jnp online-softmax
+    accumulation) or "flash" (each hop is one fused Pallas kernel emitting
+    (out, logsumexp); hops merge by stable logsumexp combination).
     """
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name, causal=causal,
+                                     scale=scale)
+    if impl != "reference":
+        raise ValueError(f"unknown attention impl {impl!r}")
     n = lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
     if scale is None:
@@ -122,6 +131,84 @@ def ring_attention(q, k, v, axis_name, *, causal: bool = True,
     init = (k, v, m0, l0, acc0)
     (k, v, m, l, acc), _ = lax.scan(step, init, jnp.arange(n))
     return _finalize(acc, l).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name, *, causal: bool,
+                          scale: float | None):
+    """Ring attention whose per-hop block compute is the fused Pallas flash
+    kernel (``flextree_tpu.ops.pallas_attention``).
+
+    Block-level causality depends only on where the visiting K/V block
+    *originates* relative to this device: strictly-past blocks are fully
+    visible (non-causal kernel call), the resident diagonal block is
+    locally causal (equal offsets cancel, so offset-0 causal is exact),
+    and strictly-future blocks contribute nothing.  ``lax.switch`` on the
+    hop's origin picks the kernel; per-hop (out, logsumexp) pairs merge
+    with the numerically stable running-max combination — the same math
+    as ``local_attention_block``, lifted from per-element to per-hop.
+    """
+    from ..ops.pallas_attention import flash_attention
+
+    n = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    idx = lax.axis_index(axis_name)
+
+    def full_hop(k_blk, v_blk):
+        return flash_attention(
+            q, k_blk, v_blk, causal=False, scale=scale, return_lse=True
+        )
+
+    def diag_hop(k_blk, v_blk):
+        return flash_attention(
+            q, k_blk, v_blk, causal=True, scale=scale, return_lse=True
+        )
+
+    def masked_hop(k_blk, v_blk):
+        return (
+            jnp.zeros_like(q),
+            jnp.full((b, t_local, h), _NEG_INF, jnp.float32),
+        )
+
+    def merge(m, so, sd, out_j, lse_j):
+        m_new = jnp.maximum(m, lse_j)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(lse_j - m_new)
+        so = so * c_old[..., None] + out_j.astype(jnp.float32) * c_new[..., None]
+        sd = sd * c_old + c_new
+        return m_new, so, sd
+
+    if n == 1:
+        out, _ = (diag_hop if causal else full_hop)(k, v)
+        return out
+
+    right = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, s):
+        k_blk, v_blk, m, so, sd = carry
+        src = (idx - s) % n
+        if causal:
+            # 0: diagonal (src == idx), 1: past (visible), 2: future (masked)
+            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+            out_j, lse_j = lax.switch(
+                branch, [diag_hop, full_hop, masked_hop], k_blk, v_blk
+            )
+        else:
+            out_j, lse_j = full_hop(k_blk, v_blk)
+        m, so, sd = merge(m, so, sd, out_j, lse_j)
+        k_blk = lax.ppermute(k_blk, axis_name, right)
+        v_blk = lax.ppermute(v_blk, axis_name, right)
+        return (k_blk, v_blk, m, so, sd), None
+
+    zero_bth = (q[..., 0] * 0).astype(jnp.float32)  # varying-axes inherit q
+    m0 = zero_bth + _NEG_INF
+    sd0 = zero_bth
+    so0 = (q * 0).astype(jnp.float32)
+    (k, v, m, so, sd), _ = lax.scan(
+        step, (k, v, m0, so0, sd0), jnp.arange(n)
+    )
+    return _finalize(so, sd.transpose(0, 2, 1)).astype(q.dtype)
 
 
 def _finalize(acc, l):

@@ -266,3 +266,131 @@ def test_train_step_with_flash_attention_matches_reference_impl():
         jax.tree.leaves(jax.device_get(outs["reference"][0]["params"])),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+# ------------------------------------------------------------- flash ring
+
+
+def test_flash_return_lse_matches_logsumexp():
+    q, k, v = _qkv(t=32)
+    out, lse = flash_attention(
+        q, k, v, causal=True, block_q=16, block_k=16, return_lse=True
+    )
+    d = q.shape[-1]
+    s = np.einsum(
+        "bqhd,bkhd->bhqk", np.asarray(q), np.asarray(k)
+    ).astype(np.float64) / np.sqrt(d)
+    t = q.shape[1]
+    mask = np.arange(t)[:, None] >= np.arange(t)[None, :]
+    s = np.where(mask[None, None], s, -np.inf)
+    want = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1)
+    want = want.transpose(0, 2, 1)  # (B, T, H)
+    np.testing.assert_allclose(np.asarray(lse), want, atol=1e-4)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_reference(sp, causal):
+    from flextree_tpu.parallel.ring_attention import ring_attention
+
+    mesh = jax.make_mesh((sp,), ("sp",))
+    q, k, v = _qkv(t=32)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(
+                q, k, v, "sp", causal=causal, impl="flash"
+            ),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    out = fn(q, k, v)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ring_flash_gradients_match_reference():
+    from flextree_tpu.parallel.ring_attention import ring_attention
+
+    mesh = jax.make_mesh((4,), ("sp",))
+    q, k, v = _qkv(t=32)
+    ring = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sp", causal=True, impl="flash"),
+        mesh=mesh,
+        in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"),
+        check_vma=False,
+    )
+    g_ring = jax.jit(
+        jax.grad(lambda q, k, v: (ring(q, k, v) ** 2).sum(), argnums=(0, 1, 2))
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: (attention_reference(q, k, v, causal=True) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ring_flash_unknown_impl_raises():
+    from flextree_tpu.parallel.ring_attention import ring_attention
+
+    mesh = jax.make_mesh((2,), ("sp",))
+    q, k, v = _qkv(t=32)
+    with pytest.raises(ValueError, match="impl"):
+        jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "sp", impl="nope"),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )(q, k, v)
+
+
+def test_forward_ring_flash_matches_reference():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        sp_impl="ring", attn_impl="flash",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+    ref = forward(params, tokens, cfg)  # no sp axis: flash local attention
+
+    mesh = jax.make_mesh((4,), ("sp",))
+    fn = jax.jit(
+        jax.shard_map(
+            lambda p, tok: forward(p, tok, cfg, sp_axis="sp"),
+            mesh=mesh,
+            in_specs=(param_specs(cfg, None), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    out = fn(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ring_flash_noncausal_single_device_axis():
+    from flextree_tpu.parallel.ring_attention import ring_attention
+
+    mesh = jax.make_mesh((1,), ("sp",))
+    q, k, v = _qkv(t=16)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_attention(
+                q, k, v, "sp", causal=False, impl="flash"
+            ),
+            mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(fn(q, k, v)),
+        np.asarray(attention_reference(q, k, v, causal=False)),
+        atol=1e-5,
+    )
