@@ -58,20 +58,12 @@ def tpu_alive(timeout_s: int = 120) -> bool:
 
 
 def _chained_s(fn, q, k, v, n_calls: int) -> float:
-    """Per-call seconds with each output fed back as the next query and a
-    final host scalar fetch — execution is forced by data dependency."""
-    import time
+    """Per-call seconds, execution forced by data dependency (shared
+    helper: ``flextree_tpu.utils.timing.time_chained``)."""
+    sys.path.insert(0, REPO)
+    from flextree_tpu.utils.timing import time_chained
 
-    import jax.numpy as jnp
-
-    warm = fn(q, k, v)
-    float(jnp.sum(warm.astype(jnp.float32)))  # compile + forced warmup
-    t0 = time.perf_counter()
-    acc = q
-    for _ in range(n_calls):
-        acc = fn(acc, k, v)
-    float(jnp.sum(acc.astype(jnp.float32)))
-    return (time.perf_counter() - t0) / n_calls
+    return time_chained(fn, q, k, v, n_calls=n_calls)
 
 
 def bench_tpu_kernel() -> dict:

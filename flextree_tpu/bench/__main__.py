@@ -16,6 +16,12 @@ import argparse
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="flextree_tpu.bench")
+    ap.add_argument(
+        "--bench",
+        choices=["allreduce", "attention"],
+        default="allreduce",
+        help="allreduce A/B (default) or fused-attention kernel benchmark",
+    )
     ap.add_argument("--size", type=int, default=35, help="elements per chip")
     ap.add_argument("--repeat", type=int, default=10)
     ap.add_argument("--comm-type", choices=["flextree", "xla"], default="flextree")
@@ -30,6 +36,20 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--dtype", type=str, default="float32")
     ap.add_argument("--op", type=str, default="sum")
+    # attention-bench geometry (--bench attention)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=4096)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument(
+        "--attn-impl", choices=["flash", "reference"], default="flash"
+    )
+    ap.add_argument(
+        "--attn-dtype",
+        type=str,
+        default="bfloat16",
+        help="compute dtype for --bench attention (independent of --dtype)",
+    )
     ap.add_argument("--tag", type=str, default="flextree")
     ap.add_argument("--to-file", action="store_true")
     ap.add_argument("--out-dir", type=str, default=".")
@@ -47,6 +67,28 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu)
+
+    if args.bench == "attention":
+        from .harness import AttentionBenchConfig, run_attention_bench
+
+        acfg = AttentionBenchConfig(
+            batch=args.batch,
+            seq_len=args.seq_len,
+            heads=args.heads,
+            head_dim=args.head_dim,
+            dtype=args.attn_dtype,
+            impl=args.attn_impl,
+            repeat=args.repeat,
+        )
+        report = run_attention_bench(
+            acfg, tag=args.tag, to_file=args.to_file, out_dir=args.out_dir
+        )
+        print(
+            f"{args.attn_impl}: {report.per_call_s * 1e3:.3f} ms/call, "
+            f"{report.tflops:.2f} TFLOP/s"
+            + (f" -> {report.result_path}" if report.result_path else "")
+        )
+        return 0
 
     from .harness import BenchConfig, run_allreduce_bench
 

@@ -36,9 +36,16 @@ from ..parallel.mesh import allreduce_over_mesh, flat_mesh
 from ..planner.cost_model import bus_bandwidth_GBps
 from ..schedule.stages import Topology
 from ..utils.logging import get_logger, result_file_name, write_result_file
-from ..utils.timing import BenchResult, time_jax_fn
+from ..utils.timing import BenchResult, time_chained, time_jax_fn
 
-__all__ = ["BenchConfig", "BenchReport", "run_allreduce_bench"]
+__all__ = [
+    "BenchConfig",
+    "BenchReport",
+    "run_allreduce_bench",
+    "AttentionBenchConfig",
+    "AttentionBenchReport",
+    "run_attention_bench",
+]
 
 log = get_logger("flextree.bench")
 
@@ -180,3 +187,90 @@ def run_allreduce_bench(cfg: BenchConfig) -> BenchReport:
         log.info("wrote %s", path)
 
     return BenchReport(cfg, n, str(topo), result, bus, correct, path)
+
+
+# ---------------------------------------------------------------- attention
+
+
+@dataclass(frozen=True)
+class AttentionBenchConfig:
+    batch: int = 4
+    seq_len: int = 4096
+    heads: int = 16
+    head_dim: int = 128
+    dtype: str = "bfloat16"
+    impl: str = "flash"  # flash | reference
+    repeat: int = 20
+    block_q: int = 512
+    block_k: int = 512
+
+
+@dataclass(frozen=True)
+class AttentionBenchReport:
+    config: AttentionBenchConfig
+    per_call_s: float
+    tflops: float
+    result_path: str | None = None
+
+    def payload(self) -> dict:
+        return {
+            "bench": "attention",
+            "impl": self.config.impl,
+            "batch": self.config.batch,
+            "seq_len": self.config.seq_len,
+            "heads": self.config.heads,
+            "head_dim": self.config.head_dim,
+            "dtype": self.config.dtype,
+            "per_call_s": self.per_call_s,
+            "tflops": self.tflops,
+        }
+
+
+def run_attention_bench(
+    cfg: AttentionBenchConfig,
+    *,
+    tag: str = "flextree",
+    to_file: bool = False,
+    out_dir: str = ".",
+) -> AttentionBenchReport:
+    """Time one attention impl with a data-dependency chain
+    (``flextree_tpu.utils.timing.time_chained``) — the completion gate that
+    holds even over the tunneled single-chip backend bench.py documents."""
+    from ..ops.pallas_attention import flash_attention
+    from ..parallel.ring_attention import attention_reference
+
+    if cfg.impl == "flash":
+        fn = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=cfg.block_q, block_k=cfg.block_k
+            )
+        )
+    elif cfg.impl == "reference":
+        fn = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
+    else:
+        raise ValueError(f"unknown attention impl {cfg.impl!r}")
+
+    b, t, h, d = cfg.batch, cfg.seq_len, cfg.heads, cfg.head_dim
+    rng = np.random.default_rng(0)
+    dtype = jnp.dtype(cfg.dtype)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((b, t, h, d)).astype(np.float32), dtype=dtype
+    )
+    q, k, v = mk(), mk(), mk()
+    per_call = time_chained(fn, q, k, v, n_calls=cfg.repeat)
+    flops = 4 * b * h * t * t * d / 2  # causal
+    report = AttentionBenchReport(cfg, per_call, flops / per_call / 1e12)
+    log.info(
+        "attention %s: %.3f ms/call, %.2f TFLOP/s",
+        cfg.impl, per_call * 1e3, report.tflops,
+    )
+    if to_file:
+        name = result_file_name(
+            tag=tag,
+            num_devices=1,
+            size=b * t * h * d,
+            topo=f"attn_{cfg.impl}",
+        )
+        path = str(write_result_file(f"{out_dir}/{name}", report.payload()))
+        report = dataclasses.replace(report, result_path=path)
+    return report

@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 
 import jax
 
-__all__ = ["Timer", "BenchResult", "time_jax_fn"]
+__all__ = ["Timer", "BenchResult", "time_jax_fn", "time_chained"]
 
 
 class Timer:
@@ -96,3 +96,26 @@ def time_jax_fn(fn, *args, repeat: int = 10, warmup: int = 2) -> BenchResult:
         jax.block_until_ready(fn(*args))
         times.append(t.stop())
     return BenchResult(tuple(times), compile_s)
+
+
+def time_chained(fn, q, *rest, n_calls: int = 10) -> float:
+    """Per-call seconds for ``fn(q, *rest)`` with each output fed back as
+    the next first argument and a final host scalar fetch.
+
+    The data-dependency chain is the one completion gate a remote/tunneled
+    backend cannot fake: ``block_until_ready`` there can return before
+    long-running work finishes (and measures round-trip latency on short
+    work), but the final fetch cannot produce bytes until every chained
+    call has executed.  Requires ``fn``'s output to have the shape/dtype
+    of its first argument.
+    """
+    import jax.numpy as jnp
+
+    warm = fn(q, *rest)
+    float(jnp.sum(warm.astype(jnp.float32)))  # compile + forced warmup
+    t0 = time.perf_counter()
+    acc = q
+    for _ in range(n_calls):
+        acc = fn(acc, *rest)
+    float(jnp.sum(acc.astype(jnp.float32)))
+    return (time.perf_counter() - t0) / n_calls

@@ -166,3 +166,37 @@ class TestBenchPyContract:
         assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
         assert payload["metric"] != "bench_error", payload
         assert payload["value"] > 0
+
+
+def test_attention_bench_runs_on_cpu():
+    from flextree_tpu.bench.harness import (
+        AttentionBenchConfig,
+        run_attention_bench,
+    )
+
+    cfg = AttentionBenchConfig(
+        batch=1, seq_len=32, heads=2, head_dim=16, dtype="float32",
+        impl="flash", repeat=1, block_q=16, block_k=16,
+    )
+    rep = run_attention_bench(cfg)
+    assert rep.per_call_s > 0 and rep.tflops > 0
+
+    ref = run_attention_bench(
+        AttentionBenchConfig(
+            batch=1, seq_len=32, heads=2, head_dim=16, dtype="float32",
+            impl="reference", repeat=1,
+        )
+    )
+    assert ref.per_call_s > 0
+
+
+def test_attention_bench_rejects_unknown_impl():
+    import pytest
+
+    from flextree_tpu.bench.harness import (
+        AttentionBenchConfig,
+        run_attention_bench,
+    )
+
+    with pytest.raises(ValueError, match="impl"):
+        run_attention_bench(AttentionBenchConfig(impl="nope", repeat=1))
