@@ -1,0 +1,180 @@
+"""End-to-end training entrypoint: ``python -m flextree_tpu.trainer``.
+
+Ties the whole framework together from the command line: pick a model
+family (dense / MoE) and parallelism layout, train on a synthetic corpus
+with the FlexTree gradient sync, checkpoint and resume.  Examples::
+
+    # dense LM, 8 virtual CPU devices, (2, 2, 2) dp/sp/tp mesh
+    python -m flextree_tpu.trainer --cpu 8 --steps 50
+
+    # pipeline-parallel over (1, 2, 2, 2) dp/pp/sp/tp
+    python -m flextree_tpu.trainer --cpu 8 --model pipeline --mesh 1,2,2,2
+
+    # mixture-of-experts over (1, 2, 2, 2) dp/ep/sp/tp with a 2-stage
+    # hierarchical gradient-sync topology
+    python -m flextree_tpu.trainer --cpu 8 --model moe --mesh 1,2,2,2 --grad-topo 2,2
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build(args):
+    """(state, step_fn, mesh, state_specs) for the chosen model family."""
+    import jax
+
+    from .models.transformer import TransformerConfig
+    from .parallel.train import TrainConfig
+
+    tc = TrainConfig(lr=args.lr, grad_topo=args.grad_topo)
+    key = jax.random.PRNGKey(args.seed)
+    mesh_shape = (
+        tuple(int(x) for x in args.mesh.split(",")) if args.mesh else None
+    )
+
+    common = dict(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        d_ff=args.d_ff,
+        sp_impl=args.sp_impl,
+        attn_impl=args.attn_impl,
+    )
+    if args.model == "dense":
+        from .parallel.train import (
+            init_train_state,
+            make_mesh_3d,
+            make_train_step,
+            state_specs,
+        )
+
+        cfg = TransformerConfig(**common)
+        mesh = make_mesh_3d(args.devices, mesh_shape)
+        return (
+            init_train_state(key, cfg),
+            make_train_step(mesh, cfg, tc),
+            mesh,
+            state_specs(cfg),
+        )
+    if args.model == "pipeline":
+        from .parallel.pipeline import (
+            init_pipeline_train_state,
+            make_mesh_4d,
+            make_pipeline_train_step,
+            pipeline_state_specs,
+        )
+
+        cfg = TransformerConfig(**common)
+        mesh = make_mesh_4d(args.devices, mesh_shape)
+        return (
+            init_pipeline_train_state(key, cfg),
+            make_pipeline_train_step(
+                mesh, cfg, tc, n_microbatches=args.microbatches
+            ),
+            mesh,
+            pipeline_state_specs(cfg),
+        )
+    if args.model == "moe":
+        from .models.moe import MoEConfig
+        from .parallel.moe_train import (
+            init_moe_train_state,
+            make_mesh_moe,
+            make_moe_train_step,
+            moe_state_specs,
+        )
+
+        cfg = MoEConfig(
+            **common,
+            n_experts=args.n_experts,
+            top_k=args.top_k,
+            capacity_factor=args.capacity_factor,
+        )
+        mesh = make_mesh_moe(args.devices, mesh_shape)
+        return (
+            init_moe_train_state(key, cfg),
+            make_moe_train_step(mesh, cfg, tc),
+            mesh,
+            moe_state_specs(cfg),
+        )
+    raise ValueError(f"unknown model {args.model!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="flextree_tpu.trainer")
+    ap.add_argument("--model", choices=["dense", "pipeline", "moe"],
+                    default="dense")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--d-ff", type=int, default=128)
+    ap.add_argument("--n-experts", type=int, default=4)
+    ap.add_argument("--top-k", type=int, default=2)
+    ap.add_argument("--capacity-factor", type=float, default=2.0)
+    ap.add_argument("--microbatches", type=int, default=2)
+    ap.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring")
+    ap.add_argument("--attn-impl", choices=["reference", "flash"],
+                    default="reference")
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--grad-topo", type=str, default=None,
+                    help="FT_TOPO-style widths for the gradient allreduce")
+    ap.add_argument("--mesh", type=str, default=None,
+                    help="comma mesh shape, e.g. 2,2,2 (dense) or 1,2,2,2")
+    ap.add_argument("--devices", type=int, default=None)
+    ap.add_argument("--cpu", type=int, default=None, metavar="N",
+                    help="run on N virtual CPU devices")
+    ap.add_argument("--corpus-tokens", type=int, default=200_000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ckpt-dir", type=str, default=None)
+    ap.add_argument("--ckpt-every", type=int, default=100)
+    ap.add_argument("--log-every", type=int, default=10)
+    ap.add_argument("--no-resume", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+
+    from .data import LMDataset, synthetic_tokens
+    from .parallel.loop import FitConfig, fit
+
+    state, step_fn, mesh, sspecs = build(args)
+    dataset = LMDataset(
+        synthetic_tokens(args.corpus_tokens, args.vocab, seed=args.seed),
+        batch=args.batch,
+        seq_len=args.seq_len,
+        seed=args.seed,
+    )
+    result = fit(
+        state,
+        step_fn,
+        dataset,
+        FitConfig(
+            num_steps=args.steps,
+            ckpt_dir=args.ckpt_dir,
+            ckpt_every=args.ckpt_every,
+            log_every=args.log_every,
+            resume=not args.no_resume,
+        ),
+        mesh=mesh,
+        state_specs=sspecs,
+    )
+    first = result.losses[0][1] if result.losses else float("nan")
+    last = result.losses[-1][1] if result.losses else float("nan")
+    print(
+        f"{args.model}: {result.steps_run} steps on mesh "
+        f"{dict(mesh.shape)}; loss {first:.4f} -> {last:.4f}"
+        + (f" (resumed from {result.resumed_from})" if result.resumed_from else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
