@@ -36,14 +36,26 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--dtype", type=str, default="float32")
     ap.add_argument("--op", type=str, default="sum")
+    ap.add_argument(
+        "--no-in-place",
+        action="store_true",
+        help="time without buffer donation (default times the reference's "
+        "MPI_IN_PLACE-style compounding loop, benchmark.cpp:149-159)",
+    )
     # attention-bench geometry (--bench attention)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq-len", type=int, default=4096)
     ap.add_argument("--heads", type=int, default=16)
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument(
-        "--attn-impl", choices=["flash", "reference"], default="flash"
+        "--attn-impl", choices=["flash", "reference", "stock"], default="flash"
     )
+    ap.add_argument(
+        "--autotune", action="store_true",
+        help="sweep (block_q, block_k) in {256,512,1024}^2 (flash impl only)",
+    )
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument(
         "--attn-dtype",
         type=str,
@@ -69,7 +81,11 @@ def main(argv=None) -> int:
         jax.config.update("jax_num_cpu_devices", args.cpu)
 
     if args.bench == "attention":
-        from .harness import AttentionBenchConfig, run_attention_bench
+        from .harness import (
+            AttentionBenchConfig,
+            autotune_attention,
+            run_attention_bench,
+        )
 
         acfg = AttentionBenchConfig(
             batch=args.batch,
@@ -79,13 +95,20 @@ def main(argv=None) -> int:
             dtype=args.attn_dtype,
             impl=args.attn_impl,
             repeat=args.repeat,
+            block_q=args.block_q,
+            block_k=args.block_k,
         )
-        report = run_attention_bench(
-            acfg, tag=args.tag, to_file=args.to_file, out_dir=args.out_dir
-        )
+        if args.autotune:
+            report = autotune_attention(acfg, repeat=args.repeat)
+        else:
+            report = run_attention_bench(
+                acfg, tag=args.tag, to_file=args.to_file, out_dir=args.out_dir
+            )
+        mfu = f" ({report.mfu * 100:.1f}% MFU)" if report.mfu is not None else ""
         print(
-            f"{args.attn_impl}: {report.per_call_s * 1e3:.3f} ms/call, "
-            f"{report.tflops:.2f} TFLOP/s"
+            f"{report.config.impl}(bq={report.config.block_q}, "
+            f"bk={report.config.block_k}): {report.per_call_s * 1e3:.3f} "
+            f"ms/call, {report.tflops:.2f} TFLOP/s{mfu}"
             + (f" -> {report.result_path}" if report.result_path else "")
         )
         return 0
@@ -103,6 +126,7 @@ def main(argv=None) -> int:
         tag=args.tag,
         to_file=args.to_file,
         out_dir=args.out_dir,
+        in_place=not args.no_in_place,
     )
     report = run_allreduce_bench(cfg)
     return 0 if report.correct else 1

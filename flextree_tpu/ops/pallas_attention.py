@@ -38,6 +38,13 @@ __all__ = ["flash_attention", "attention_with_offsets"]
 _NEG_INF = -1e30
 _LANE = 128  # lse is lane-replicated to satisfy Mosaic's (8, 128) block rule
 
+# forward k-loop unroll factor (env-overridable for tuning experiments);
+# measured neutral-to-slightly-negative on v5e at the benchmark shape, so
+# the default stays 1 — the knob exists for other chips/shapes
+import os as _os
+
+_FWD_UNROLL = int(_os.environ.get("FLEXTREE_FLASH_UNROLL", "1"))
+
 
 def attention_with_offsets(
     q, k, v, *, causal: bool, scale: float, q_offset=0, k_offset=0
@@ -75,6 +82,7 @@ def _flash_kernel(
     scale: float,
     q_offset: int,
     k_offset: int,
+    unroll: int = 1,
 ):
     i = pl.program_id(1)
     q = q_ref[0]  # (bq, D), native dtype — bf16 q/k feed the MXU directly
@@ -88,7 +96,7 @@ def _flash_kernel(
     else:
         kb_hi = n_kb
 
-    def body(j, carry):
+    def step(j, carry):
         m, l, acc = carry
         kb = k_ref[0, pl.ds(j * block_k, block_k), :]
         vb = v_ref[0, pl.ds(j * block_k, block_k), :]
@@ -126,7 +134,7 @@ def _flash_kernel(
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, kb_hi, body, (m0, l0, acc0))
+    m, l, acc = lax.fori_loop(0, kb_hi, step, (m0, l0, acc0), unroll=unroll)
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
     if maybe_lse_ref:  # only the differentiated path pays for the lse store
@@ -138,10 +146,16 @@ def _flash_kernel(
 
 
 def _blocks(q, k, block_q, block_k):
-    """Resolved (bq, bk, tq_pad, tk_pad, interpret-independent) geometry."""
+    """Resolved (bq, bk, tq_pad, tk_pad, interpret-independent) geometry.
+
+    Clamped block sizes are rounded up to a multiple of 8 (Mosaic's
+    second-minor tiling unit for f32): tq=100 must yield bq=104, not 100 —
+    a non-multiple-of-8 block would tile poorly or be rejected on real TPU.
+    The sequence padding below already absorbs the overshoot.
+    """
     tq, tk = q.shape[1], k.shape[1]
-    bq = min(block_q, max(tq, 8))
-    bk = min(block_k, max(tk, 8))
+    bq = -(-min(block_q, max(tq, 8)) // 8) * 8
+    bk = -(-min(block_k, max(tk, 8)) // 8) * 8
     return bq, bk, -(-tq // bq) * bq, -(-tk // bk) * bk
 
 
@@ -191,6 +205,7 @@ def _flash_fwd_impl(
             scale=scale,
             q_offset=q_offset,
             k_offset=k_offset,
+            unroll=_FWD_UNROLL,
         ),
         out_shape=tuple(out_shape),
         grid=(b * h, tq_pad // bq),

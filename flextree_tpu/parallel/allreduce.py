@@ -44,6 +44,11 @@ from ..schedule.stages import Topology
 
 __all__ = ["allreduce", "tree_allreduce", "ring_allreduce", "reduce_scatter", "allgather"]
 
+# captured at import time so the interposer (``flextree_tpu.interpose``)
+# shadowing ``jax.lax.psum`` can never make our own tail reduction recurse
+# back into ``allreduce``
+_NATIVE_PSUM = lax.psum
+
 
 def _jnp_fn(rop: ReduceOp):
     return getattr(jnp, rop.jnp_name)
@@ -56,6 +61,46 @@ def _flatten_pad(x: jax.Array, n: int, rop: ReduceOp):
     if layout.pad:
         v = jnp.pad(v, (0, layout.pad), constant_values=rop.identity_for(x.dtype))
     return v, layout
+
+
+def _groups_or_none(topo: Topology, stage: int):
+    """``axis_index_groups`` for ``stage`` — or ``None`` when the stage's one
+    group spans the whole axis (XLA's ungrouped collectives take a faster
+    path than a single explicit full group)."""
+    groups = topo.groups(stage)
+    return None if len(groups) == 1 else groups
+
+
+def _split_main_tail(x: jax.Array, n: int):
+    """Split a flat buffer into an evenly-divisible head and a tiny tail.
+
+    The reference handles counts not divisible by N by clamping/emptying
+    trailing blocks per-message (``mpi_mod.hpp:679-696``).  XLA collectives
+    want uniform shards; padding the whole buffer to ``split_size*N``
+    (round 1's approach) costs a full-buffer copy in and out *and* defeats
+    buffer donation.  Instead the first ``(count//N)*N`` elements go through
+    the scheduled collective unpadded and the <N-element tail is reduced by
+    a single tiny dense collective.
+    """
+    v = x.reshape(-1)
+    main = (v.size // n) * n
+    if main == 0:
+        return None, v
+    if main == v.size:
+        return v, None
+    return v[:main], v[main:]
+
+
+def _small_dense_allreduce(t, axis_name, rop: ReduceOp):
+    """Allreduce for a sub-N-element tail: one dense collective."""
+    if rop.name == "sum":
+        return _NATIVE_PSUM(t, axis_name)
+    stacked = lax.all_gather(t, axis_name, axis=0, tiled=False)
+    fn = _jnp_fn(rop)
+    red = stacked[0]
+    for j in range(1, stacked.shape[0]):
+        red = fn(red, stacked[j])
+    return red
 
 
 # --------------------------------------------------------------------------
@@ -91,17 +136,26 @@ def allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
 
 
 def tree_allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
-    """Hierarchical allreduce with per-stage widths ``topo.widths``."""
+    """Hierarchical allreduce with per-stage widths ``topo.widths``.
+
+    Non-divisible element counts run as an unpadded scheduled collective on
+    the divisible head plus one tiny dense collective on the <N-element
+    tail (``_split_main_tail``) — no full-buffer pad/slice copies.
+    """
     n = lax.axis_size(axis_name)
     rop = get_op(op)
     rop.check_dtype(x.dtype)
     topo = Topology.resolve(n, topo)
     shape = x.shape
-    v, layout = _flatten_pad(x, n, rop)
-    v = _tree_reduce_scatter(v, axis_name, topo, rop)
-    v = _tree_allgather(v, axis_name, topo)
-    if layout.pad:
-        v = v[: layout.count]
+    head, tail = _split_main_tail(x, n)
+    parts = []
+    if head is not None:
+        h = _tree_reduce_scatter(head, axis_name, topo, rop)
+        h = _tree_allgather(h, axis_name, topo)
+        parts.append(h)
+    if tail is not None:
+        parts.append(_small_dense_allreduce(tail, axis_name, rop))
+    v = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return v.reshape(shape)
 
 
@@ -114,7 +168,7 @@ def _tree_reduce_scatter(v, axis_name, topo: Topology, rop: ReduceOp):
     """
     for i, w in enumerate(topo.widths):
         with jax.named_scope(f"ft_rs_stage{i}_w{w}"):
-            groups = topo.groups(i)
+            groups = _groups_or_none(topo, i)
             if rop.name == "sum":
                 v = lax.psum_scatter(
                     v,
@@ -133,31 +187,55 @@ def _tree_allgather(v, axis_name, topo: Topology):
     for i in reversed(range(topo.num_stages)):
         with jax.named_scope(f"ft_ag_stage{i}_w{topo.widths[i]}"):
             v = lax.all_gather(
-                v, axis_name, axis_index_groups=topo.groups(i), axis=0, tiled=True
+                v, axis_name, axis_index_groups=_groups_or_none(topo, i),
+                axis=0, tiled=True,
             )
     return v
 
 
 def _grouped_reduce_scatter_generic(v, axis_name, topo: Topology, stage: int, rop: ReduceOp):
-    """Width-w grouped reduce-scatter for non-sum ops.
+    """Width-w grouped reduce-scatter for non-sum ops: a true ring exchange.
 
-    ``psum_scatter`` only sums, so for band/bor/bxor/max/min/prod we gather
-    the w group copies (stacked), fold the op (statically unrolled — XLA
-    fuses the elementwise chain; this is the moral equivalent of the
-    reference's per-source-count unrolled ``reduce_band``,
-    ``mpi_mod.hpp:454-660``), then keep our group-position tile.
+    ``psum_scatter`` only sums, so band/bor/bxor/max/min/prod run the
+    classic ring reduce-scatter *within each stage group*, all groups in
+    parallel through one global ``ppermute`` per step: ``w-1`` steps, each
+    moving ``1/w`` of the tile and folding the op — the same
+    ``(w-1)/w``-of-the-tile traffic as the reference's per-block
+    send/recv/reduce path (``mpi_mod.hpp:454-660, 769-878``), unlike the
+    round-1 all_gather+fold which moved the whole group payload to every
+    member.
+
+    Block walk: group member at position ``p`` (ranks ``base + j*gap``)
+    plays the reference ring with label ``p-1``, so after ``w-1`` folds it
+    owns fully-reduced block ``p`` — matching ``psum_scatter(tiled=True)``
+    ownership so the sum and non-sum stage outputs are interchangeable.
     """
+    n = topo.num_nodes
     w, gap = topo.widths[stage], topo.gaps[stage]
     fn = _jnp_fn(rop)
-    stacked = lax.all_gather(
-        v, axis_name, axis_index_groups=topo.groups(stage), axis=0, tiled=False
-    )
-    red = stacked[0]
-    for j in range(1, w):
-        red = fn(red, stacked[j])
     tile = v.shape[0] // w
-    pos = (lax.axis_index(axis_name) // gap) % w
-    return lax.dynamic_slice_in_dim(red, pos * tile, tile, axis=0)
+    idx = lax.axis_index(axis_name)
+    pos = (idx // gap) % w
+
+    def next_in_group(r: int) -> int:
+        g0 = (r // (gap * w)) * (gap * w) + r % gap
+        p = (r // gap) % w
+        return g0 + ((p + 1) % w) * gap
+
+    perm = [(r, next_in_group(r)) for r in range(n)]
+
+    def step(s, carry):
+        acc, cur_send = carry
+        # cur_send: the block index this rank sends this step
+        chunk = lax.dynamic_slice_in_dim(acc, cur_send * tile, tile, axis=0)
+        got = lax.ppermute(chunk, axis_name, perm)
+        recv_b = (cur_send - 1) % w
+        cur = lax.dynamic_slice_in_dim(acc, recv_b * tile, tile, axis=0)
+        acc = lax.dynamic_update_slice_in_dim(acc, fn(cur, got), recv_b * tile, axis=0)
+        return acc, recv_b
+
+    acc, _ = lax.fori_loop(0, w - 1, step, (v, (pos - 1) % w), unroll=False)
+    return lax.dynamic_slice_in_dim(acc, pos * tile, tile, axis=0)
 
 
 # --------------------------------------------------------------------------
@@ -182,30 +260,35 @@ def ring_allreduce(x: jax.Array, axis_name, op="sum") -> jax.Array:
         return x
     fn = _jnp_fn(rop)
     shape = x.shape
-    v, layout = _flatten_pad(x, n, rop)
-    split = v.shape[0] // n
-    idx = lax.axis_index(axis_name)
-    right_perm = [(j, (j + 1) % n) for j in range(n)]
+    head, tail = _split_main_tail(x, n)
+    parts = []
+    if head is not None:
+        v = head
+        split = v.shape[0] // n
+        idx = lax.axis_index(axis_name)
+        right_perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def reduce_step(s, v):
-        send_b = (idx - s) % n
-        recv_b = (idx - s - 1) % n
-        chunk = lax.dynamic_slice_in_dim(v, send_b * split, split, axis=0)
-        got = lax.ppermute(chunk, axis_name, right_perm)
-        cur = lax.dynamic_slice_in_dim(v, recv_b * split, split, axis=0)
-        return lax.dynamic_update_slice_in_dim(v, fn(cur, got), recv_b * split, axis=0)
+        def reduce_step(s, v):
+            send_b = (idx - s) % n
+            recv_b = (idx - s - 1) % n
+            chunk = lax.dynamic_slice_in_dim(v, send_b * split, split, axis=0)
+            got = lax.ppermute(chunk, axis_name, right_perm)
+            cur = lax.dynamic_slice_in_dim(v, recv_b * split, split, axis=0)
+            return lax.dynamic_update_slice_in_dim(v, fn(cur, got), recv_b * split, axis=0)
 
-    def gather_step(s, v):
-        send_b = (idx + 1 - s) % n
-        recv_b = (idx - s) % n
-        chunk = lax.dynamic_slice_in_dim(v, send_b * split, split, axis=0)
-        got = lax.ppermute(chunk, axis_name, right_perm)
-        return lax.dynamic_update_slice_in_dim(v, got, recv_b * split, axis=0)
+        def gather_step(s, v):
+            send_b = (idx + 1 - s) % n
+            recv_b = (idx - s) % n
+            chunk = lax.dynamic_slice_in_dim(v, send_b * split, split, axis=0)
+            got = lax.ppermute(chunk, axis_name, right_perm)
+            return lax.dynamic_update_slice_in_dim(v, got, recv_b * split, axis=0)
 
-    v = lax.fori_loop(0, n - 1, reduce_step, v, unroll=False)
-    v = lax.fori_loop(0, n - 1, gather_step, v, unroll=False)
-    if layout.pad:
-        v = v[: layout.count]
+        v = lax.fori_loop(0, n - 1, reduce_step, v, unroll=False)
+        v = lax.fori_loop(0, n - 1, gather_step, v, unroll=False)
+        parts.append(v)
+    if tail is not None:
+        parts.append(_small_dense_allreduce(tail, axis_name, rop))
+    v = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return v.reshape(shape)
 
 

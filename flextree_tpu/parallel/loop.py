@@ -34,6 +34,10 @@ class FitConfig:
     max_to_keep: int = 3
     log_every: int = 10
     resume: bool = True  # restore from ckpt_dir's latest checkpoint if any
+    # background-prefetch depth (0 disables): batches are pulled this many
+    # steps ahead on a daemon thread (``flextree_tpu.data.prefetch``) while
+    # the current step runs on device
+    prefetch: int = 2
 
 
 @dataclasses.dataclass
@@ -72,8 +76,13 @@ def fit(
     start = int(np.asarray(jax.device_get(state["step"])))
     t0 = time.perf_counter()
     step = start
+    batches = None
+    if cfg.prefetch and start < cfg.num_steps and hasattr(dataset, "iter_from"):
+        from ..data import prefetch as _prefetch
+
+        batches = _prefetch(dataset.iter_from(start), size=cfg.prefetch)
     while step < cfg.num_steps:
-        tokens, targets = dataset.batch_at(step)
+        tokens, targets = next(batches) if batches is not None else dataset.batch_at(step)
         state, metrics = step_fn(state, tokens, targets)
         step += 1
         if cfg.log_every and (step % cfg.log_every == 0 or step == cfg.num_steps):

@@ -52,13 +52,21 @@ def topology_from_mesh(mesh: Mesh, axis_name=None) -> Topology:
     return Topology(n, widths)
 
 
-def allreduce_over_mesh(stacked, mesh: Mesh, topo=None, op="sum", axis_name=None):
+def allreduce_over_mesh(
+    stacked, mesh: Mesh, topo=None, op="sum", axis_name=None, in_place: bool = False
+):
     """Allreduce a stacked ``(N, ...)`` array: row ``i`` lives on device ``i``
     of ``mesh``'s ``axis_name`` axis; every output row is the full reduction.
 
     This is the host-side harness the benchmark and tests use — the analog of
     the reference benchmark calling ``MPI_Allreduce_FT`` on each rank's local
     buffer (``benchmark.cpp:153``).
+
+    ``in_place=True`` donates ``stacked`` to the computation — the analog of
+    the reference's ``MPI_IN_PLACE`` path (``mpi_mod.hpp:1193-1215``; the
+    reference benchmark always runs in-place, ``benchmark.cpp:153``).  The
+    caller's array is consumed; XLA reuses its buffer for the output, which
+    removes the output allocation + copy from the hot path.
     """
     axis = axis_name or mesh.axis_names[0]
     n = mesh.shape[axis]
@@ -67,13 +75,13 @@ def allreduce_over_mesh(stacked, mesh: Mesh, topo=None, op="sum", axis_name=None
             f"stacked.shape[0]={stacked.shape[0]} must equal mesh axis {axis!r} size {n}"
         )
     topo = Topology.resolve(n, topo)
-    return _jitted_allreduce(mesh, axis, topo, op if isinstance(op, str) else op.name)(
-        stacked
-    )
+    return _jitted_allreduce(
+        mesh, axis, topo, op if isinstance(op, str) else op.name, in_place
+    )(stacked)
 
 
 @functools.lru_cache(maxsize=256)
-def _jitted_allreduce(mesh: Mesh, axis: str, topo: Topology, op: str):
+def _jitted_allreduce(mesh: Mesh, axis: str, topo: Topology, op: str, donate: bool = False):
     """Cache the compiled collective per (mesh, axis, topo, op) so repeated
     host-level calls (benchmark loops) hit the jit cache instead of
     rebuilding a fresh closure every call."""
@@ -82,5 +90,6 @@ def _jitted_allreduce(mesh: Mesh, axis: str, topo: Topology, op: str):
         return allreduce(row[0], axis, topo, op)[None]
 
     return jax.jit(
-        jax.shard_map(per_device, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+        jax.shard_map(per_device, mesh=mesh, in_specs=P(axis), out_specs=P(axis)),
+        donate_argnums=(0,) if donate else (),
     )

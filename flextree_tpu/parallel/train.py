@@ -74,7 +74,9 @@ class TrainConfig:
     weight_decay: float = 0.0
     # topology spec for the gradient-sync allreduce (None -> FT_TOPO/flat).
     # Either one spec — used on every mesh axis whose size matches its
-    # product, flat elsewhere — or a dict {axis_name: spec}.
+    # product, flat elsewhere — or a dict {axis_name: spec}.  The sentinel
+    # "psum" selects the native XLA all-reduce instead of FlexTree — the
+    # A/B oracle (and escape hatch) inside the production train step.
     grad_topo: Any = None
 
 
@@ -196,6 +198,8 @@ def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
         spec = grad_topo
         if isinstance(spec, dict):
             spec = spec.get(ax)
+        if spec == "psum":
+            return None  # sentinel: native XLA all-reduce on this axis
         try:
             return Topology.resolve(mesh.shape[ax], spec)
         except TopologyError:
@@ -205,11 +209,20 @@ def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
 
 
 def sync_grads(grads, pspecs, mesh_axes, topos: dict):
-    """FlexTree gradient sync: sum each leaf over its replication axes."""
+    """FlexTree gradient sync: sum each leaf over its replication axes.
+
+    An axis whose topology is ``None`` (the ``"psum"`` sentinel) uses the
+    native all-reduce — the in-step analog of the benchmark's
+    ``--comm-type xla`` baseline."""
+    from .allreduce import _NATIVE_PSUM
 
     def sync(g, spec):
         for ax in _replication_axes(spec, mesh_axes):
-            g = allreduce(g, ax, topo=topos[ax], op="sum")
+            topo = topos[ax]
+            if topo is None:
+                g = _NATIVE_PSUM(g, ax)
+            else:
+                g = allreduce(g, ax, topo=topo, op="sum")
         return g
 
     return jax.tree.map(sync, grads, pspecs, is_leaf=lambda x: x is None)

@@ -20,6 +20,14 @@ from .cost_model import (
     bus_bandwidth_GBps,
     ring_cost,
 )
+from .calibrate import (
+    MeasuredPoint,
+    feature_vector,
+    fit_cost_params,
+    measure_points,
+    predict_us,
+    spearman,
+)
 from .choose import Candidate, Plan, candidate_topologies, choose_topology
 from .factorize import (
     count_ordered_factorizations,
@@ -44,6 +52,12 @@ __all__ = [
     "allreduce_cost",
     "ring_cost",
     "bus_bandwidth_GBps",
+    "MeasuredPoint",
+    "measure_points",
+    "feature_vector",
+    "fit_cost_params",
+    "predict_us",
+    "spearman",
     "Candidate",
     "Plan",
     "candidate_topologies",

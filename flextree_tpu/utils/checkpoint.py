@@ -169,12 +169,23 @@ def save_train_state(
     *,
     max_to_keep: int = 3,
 ) -> str:
-    """Save a train state keyed by its ``state['step']``; prune old ones."""
+    """Save a train state keyed by its ``state['step']``; prune old ones.
+
+    Pruning never deletes the checkpoint just written, even when the
+    directory already holds ``max_to_keep`` higher-step files (e.g. a fresh
+    run reusing an old checkpoint dir): the just-written path is exempt and
+    stale higher-step checkpoints are pruned *first*, so a later resume
+    finds this state — not a silently-restored stale higher step.
+    """
     step = int(np.asarray(jax.device_get(state["step"])))
     path = os.path.join(os.fspath(ckpt_dir), f"ckpt_{step:08d}.npz")
     save_checkpoint(path, state)
     if max_to_keep is not None and max_to_keep > 0:
-        for _, old in list_checkpoints(ckpt_dir)[:-max_to_keep]:
+        others = [(s, p) for s, p in list_checkpoints(ckpt_dir) if p != path]
+        stale = [(s, p) for s, p in others if s > step]  # from an older run
+        fresh = [(s, p) for s, p in others if s <= step]
+        keep_others = max_to_keep - 1  # the new file occupies one slot
+        for _, old in stale + fresh[: max(0, len(fresh) - keep_others)]:
             os.unlink(old)
     return path
 

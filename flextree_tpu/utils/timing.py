@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 
 import jax
 
-__all__ = ["Timer", "BenchResult", "time_jax_fn", "time_chained"]
+__all__ = ["Timer", "BenchResult", "time_jax_fn", "time_jax_fn_inplace", "time_chained"]
 
 
 class Timer:
@@ -94,6 +94,33 @@ def time_jax_fn(fn, *args, repeat: int = 10, warmup: int = 2) -> BenchResult:
     for _ in range(repeat):
         t.restart()
         jax.block_until_ready(fn(*args))
+        times.append(t.stop())
+    return BenchResult(tuple(times), compile_s)
+
+
+def time_jax_fn_inplace(fn, x, repeat: int = 10, warmup: int = 2) -> BenchResult:
+    """Time ``fn`` in-place: each output feeds the next call's input.
+
+    This is the protocol of the reference benchmark's compounding
+    ``MPI_IN_PLACE`` loop (``benchmark.cpp:149-159``): the same buffer is
+    reduced again and again.  It is the only valid way to time a *donating*
+    jit (the donated input is consumed, so re-calling on the original array
+    would die), and it works identically for non-donating ``fn`` — so both
+    sides of an A/B can share it.  ``fn``'s output must match its input in
+    shape/dtype/sharding.
+    """
+    t = Timer()
+    acc = fn(x)
+    jax.block_until_ready(acc)
+    compile_s = t.stop()
+    for _ in range(warmup):
+        acc = fn(acc)
+    jax.block_until_ready(acc)
+    times = []
+    for _ in range(repeat):
+        t.restart()
+        acc = fn(acc)
+        jax.block_until_ready(acc)
         times.append(t.stop())
     return BenchResult(tuple(times), compile_s)
 

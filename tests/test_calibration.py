@@ -1,0 +1,99 @@
+"""Cost-model calibration: the fitted model must *predict measured
+orderings* — the property the reference's hand-calibrated constants
+implicitly had (``cost_model/CostModel.h:1-30``) and round 1's invented
+defaults did not.
+
+Validation per VERDICT r1 item 2: Spearman rank correlation >= 0.8 between
+predicted and measured times over 5 shapes x 2 sizes on the 8-vdev mesh,
+and the planner's argmin must be the measured winner or within noise of it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from flextree_tpu.planner import (
+    choose_topology,
+    fit_cost_params,
+    measure_points,
+    predict_us,
+    spearman,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+TOPOS = ["8", "4,2", "2,4", "2,2,2", "1"]
+SIZES = [1 << 16, 1 << 20]  # 256 KB and 4 MB float32
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    points = measure_points(TOPOS, SIZES, repeat=3, devices=8)
+    params = fit_cost_params(points)
+    return points, params
+
+
+@pytest.mark.slow
+def test_fitted_model_rank_correlates(fitted):
+    points, params = fitted
+    measured = [p.measured_us for p in points]
+    predicted = [
+        predict_us(params, p.widths, p.num_nodes, p.nbytes) for p in points
+    ]
+    rho = spearman(predicted, measured)
+    assert rho >= 0.8, (
+        f"Spearman {rho:.3f} < 0.8\n"
+        + "\n".join(
+            f"  {p.widths} @ {p.nbytes >> 10}KB: measured {m:.0f}us, "
+            f"predicted {q:.0f}us"
+            for p, m, q in zip(points, measured, predicted)
+        )
+    )
+
+
+@pytest.mark.slow
+def test_planner_argmin_is_measured_winner(fitted):
+    points, params = fitted
+    for nbytes in [s * 4 for s in SIZES]:
+        plan = choose_topology(8, nbytes, params=params)
+        chosen = plan.widths
+        at_size = [p for p in points if p.nbytes == nbytes]
+        best = min(at_size, key=lambda p: p.measured_us)
+        chosen_meas = next(
+            (p.measured_us for p in at_size if p.widths == chosen), None
+        )
+        assert chosen_meas is not None, f"planner chose unmeasured {chosen}"
+        # winner, or within 15% of the winner (measurement noise on a
+        # timeshared single-core host)
+        assert chosen_meas <= best.measured_us * 1.15, (
+            f"planner chose {chosen} ({chosen_meas:.0f}us) but measured "
+            f"winner is {best.widths} ({best.measured_us:.0f}us)"
+        )
+
+
+def test_fit_recovers_synthetic_constants():
+    """Fit on model-generated data must recover the generating ordering
+    exactly (pure math, no devices)."""
+    from flextree_tpu.planner import LinkParams, TpuCostParams
+    from flextree_tpu.planner.calibrate import MeasuredPoint
+
+    true = TpuCostParams(
+        ici=LinkParams(bandwidth_GBps=2.0, latency_us=50.0),
+        dcn=LinkParams(bandwidth_GBps=2.0, latency_us=50.0),
+        reduce_bw_GBps=8.0,
+        control_us_per_width=0.0,
+        launch_us=400.0,
+    )
+    shapes = [(8,), (4, 2), (2, 4), (2, 2, 2), (1,)]
+    pts = [
+        MeasuredPoint(w, 8, nb, predict_us(true, w, 8, nb))
+        for w in shapes
+        for nb in [1 << 18, 1 << 22]
+    ]
+    fit = fit_cost_params(pts)
+    for p in pts:
+        got = predict_us(fit, p.widths, p.num_nodes, p.nbytes)
+        assert abs(got - p.measured_us) <= 0.05 * p.measured_us + 1.0

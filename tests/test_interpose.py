@@ -115,6 +115,53 @@ def test_pytree_psum():
     np.testing.assert_allclose(np.asarray(out["b"]), np.full(8, 56.0))
 
 
+def test_alias_patching_covers_from_imports():
+    """A module that did ``from jax.lax import psum`` before install() must
+    still get FlexTree (the reference's whole-TU shadow guarantee,
+    mpi_mod.hpp:1167-1171) — and be restored on uninstall."""
+    import sys
+    import types
+
+    mod = types.ModuleType("fake_host_framework")
+    exec("from jax.lax import psum", mod.__dict__)
+    sys.modules["fake_host_framework"] = mod
+    try:
+        native = mod.psum
+        with interposed(topo="1"):
+            assert hasattr(mod.psum, "_flextree_interposer")
+            mesh = jax.make_mesh((8,), ("ft",))
+            ir = jax.jit(
+                jax.shard_map(
+                    lambda v: mod.psum(v, "ft"), mesh=mesh,
+                    in_specs=P("ft"), out_specs=P("ft"), check_vma=False,
+                )
+            ).lower(jnp.ones((8, 16), jnp.float32)).as_text()
+            assert "collective_permute" in ir  # ring lowering, not all-reduce
+        assert mod.psum is native  # uninstall restored the alias site
+    finally:
+        del sys.modules["fake_host_framework"]
+
+
+def test_alias_miss_without_patching():
+    """patch_aliases=False reproduces the round-1 limitation: early
+    ``from jax.lax import psum`` aliases keep the native primitive."""
+    import sys
+    import types
+
+    mod = types.ModuleType("fake_host_framework2")
+    exec("from jax.lax import psum", mod.__dict__)
+    sys.modules["fake_host_framework2"] = mod
+    try:
+        install(topo="1", patch_aliases=False)
+        try:
+            assert not hasattr(mod.psum, "_flextree_interposer")
+            assert hasattr(jax.lax.psum, "_flextree_interposer")
+        finally:
+            uninstall()
+    finally:
+        del sys.modules["fake_host_framework2"]
+
+
 def test_install_uninstall_state():
     assert not is_installed()
     install()

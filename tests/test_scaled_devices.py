@@ -1,0 +1,106 @@
+"""XLA-backend correctness past 8 devices: 12, 16, and 60 virtual CPU ranks.
+
+Round 1 compiled the XLA lowering only at N=8 (the conftest mesh); the
+groups math (``axis_index_groups`` construction, multi-stage trees,
+non-divisible tails) was never executed at the BASELINE.md rank counts.
+These tests run each rank count in a subprocess (``jax_num_cpu_devices``
+must be set before backend init, and the suite's backend is pinned to 8),
+checking every topology against dense NumPy ground truth and lax.psum —
+the same oracles as ``test_xla_allreduce.py``.
+
+The 60-rank schedule/simulator coverage (no devices needed) lives at the
+bottom: BASELINE config 5's width choices validated and simulated
+in-process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from flextree_tpu.backends import simulate_allreduce
+from flextree_tpu.schedule import Topology
+from flextree_tpu.schedule.validate import validate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = textwrap.dedent(
+    """
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", {n})
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from flextree_tpu.parallel import allreduce_over_mesh, flat_mesh
+
+    n = {n}
+    mesh = flat_mesh(n, "ft")
+    rng = np.random.default_rng(0)
+    failures = []
+    for topo in {topos!r}:
+        for count in {counts!r}:
+            data = rng.standard_normal((n, count)).astype(np.float32)
+            out = np.asarray(
+                allreduce_over_mesh(jnp.asarray(data), mesh, topo=topo)
+            )
+            expect = np.tile(data.sum(0), (n, 1))
+            if not np.allclose(out, expect, rtol=1e-3, atol=1e-3):
+                failures.append((topo, count, float(np.abs(out - expect).max())))
+    print("RESULT " + json.dumps(failures))
+    sys.exit(1 if failures else 0)
+    """
+)
+
+
+def _run_child(n, topos, counts, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("FT_TOPO", None)
+    code = _CHILD.format(n=n, topos=topos, counts=counts)
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, env=env, timeout=timeout,
+    )
+    failures = None
+    for line in p.stdout.splitlines():
+        if line.startswith("RESULT "):
+            failures = json.loads(line[7:])
+    assert failures is not None, f"child crashed:\n{p.stderr[-3000:]}"
+    assert failures == [], f"mismatches: {failures}"
+
+
+@pytest.mark.slow
+def test_16_devices_all_topologies():
+    # (16,), (4,4), (2,2,2,2), (8,2), ring — divisible and tail counts
+    _run_child(16, ["16", "4,4", "2,2,2,2", "8,2", "1"], [64, 37])
+
+
+@pytest.mark.slow
+def test_12_devices_mixed_width_topologies():
+    # non-power-of-2 widths (3,4)/(2,3,2) mirror the simulator coverage
+    _run_child(12, ["12", "3,4", "4,3", "2,3,2", "1"], [48, 35])
+
+
+@pytest.mark.slow
+def test_60_devices_baseline_config5():
+    # BASELINE config 5: non-power-of-2 world size, planner width choices
+    _run_child(60, ["60", "4,15", "5,12", "3,4,5"], [120, 61])
+
+
+# ------------------------- schedule-level 60-rank coverage (no devices)
+
+
+@pytest.mark.parametrize("widths", [(60,), (4, 15), (5, 12), (3, 4, 5), (2, 30)])
+def test_60_rank_schedule_validates_and_simulates(widths):
+    topo = Topology(60, widths)
+    validate(topo)  # raises on any double-send/ownership violation
+    data = np.random.default_rng(1).integers(0, 100, size=(60, 61)).astype(np.int64)
+    sim = simulate_allreduce(data, widths)
+    np.testing.assert_array_equal(sim, np.tile(data.sum(0), (60, 1)))

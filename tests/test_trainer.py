@@ -144,6 +144,33 @@ def test_fit_completed_run_resumes_to_noop(tmp_path):
     assert again.steps_run == 0 and again.resumed_from == 4
 
 
+# ------------------- multi-stage tree in the production train step
+
+
+@pytest.mark.parametrize("tree_topo", ["4,2", "2,2,2"])
+def test_multistage_grad_sync_matches_psum(tree_topo):
+    """The gradient allreduce over an 8-wide dp axis with a real multi-stage
+    tree must produce the same training step as native psum sync — the
+    FlexTree production path (``mpi_mod.hpp:953-1111`` as the host
+    framework's gradient sync), not a side-door demo."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    mesh = make_mesh_3d(8, (8, 1, 1))  # single 8-wide dp axis
+    state = init_train_state(jax.random.PRNGKey(0), cfg)
+    ds = LMDataset(synthetic_tokens(20_000, 64), batch=8, seq_len=32, seed=0)
+    tokens, targets = ds.batch_at(0)
+
+    step_psum = make_train_step(mesh, cfg, TrainConfig(lr=3e-3, grad_topo="psum"))
+    step_tree = make_train_step(mesh, cfg, TrainConfig(lr=3e-3, grad_topo=tree_topo))
+
+    s_psum, m_psum = step_psum(state, tokens, targets)
+    s_tree, m_tree = step_tree(state, tokens, targets)
+    assert np.isclose(float(m_psum["loss"]), float(m_tree["loss"]), rtol=1e-6)
+    for a, b in zip(_leaves(s_psum["params"]), _leaves(s_tree["params"])):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
 # ------------------------------------------------------------------- CLI
 
 

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""HBM roofline for the local reduce kernel on the real TPU chip.
+
+The local reduction is the allreduce's only compute (SURVEY §3.2 "HOT
+LOOP"; the reference's OpenMP ``reduce_sum``, ``mpi_mod.hpp:246-660``), and
+it is HBM-bandwidth-bound: folding W sources reads W·L and writes L
+elements.  This tool measures ``flextree_tpu.ops.pallas_reduce`` achieved
+HBM GB/s against the chip's peak (VERDICT r1 item 9) and writes the
+committed artifact ``BENCH_REDUCE_ROOFLINE.json``.
+
+Timing is a data-dependency chain inside one jit (a ``lax.scan`` whose
+carry folds each iteration's output back into the next input with an
+in-place dynamic-update-slice), ended by a host scalar fetch — the only
+completion gate the tunneled single-chip backend can't fake (see bench.py).
+The DUS adds one extra L-element write+read per iteration, so per-iteration
+moved bytes are accounted as (W+2)·L·itemsize (kernel (W+1)·L + DUS ~L).
+
+Usage: python tools/roofline_reduce.py [--out BENCH_REDUCE_ROOFLINE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: HBM peak GB/s by device_kind substring (v5e 819, v4 1228, v5p 2765, v6e 1638)
+_TPU_PEAK_HBM = (
+    ("v5 lite", 819.0),
+    ("v5litepod", 819.0),
+    ("v5e", 819.0),
+    ("v6 lite", 1638.0),
+    ("v6e", 1638.0),
+    ("v5p", 2765.0),
+    ("v5", 2765.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+)
+
+
+def chip_peak_hbm_GBps():
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return None
+    kind = getattr(dev, "device_kind", "").lower()
+    for sub, peak in _TPU_PEAK_HBM:
+        if sub in kind:
+            return peak
+    return None
+
+
+def measure_point(w: int, length: int, dtype_name: str, iters: int, rows_tile: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from flextree_tpu.ops.pallas_reduce import reduce_stacked
+
+    dtype = jnp.dtype(dtype_name)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((w, length)).astype(np.float32) * 1e-3, dtype=dtype
+    )
+
+    @jax.jit
+    def chain(x0):
+        def body(carry, _):
+            out = reduce_stacked(carry, op="sum", rows_tile=rows_tile,
+                                 interpret=False)
+            carry = lax.dynamic_update_slice(carry, out[None] * 1e-3, (0, 0))
+            return carry, ()
+
+        return lax.scan(body, x0, None, length=iters)[0]
+
+    warm = chain(x)
+    float(jnp.sum(warm[0][:8].astype(jnp.float32)))  # compile + force
+    t0 = time.perf_counter()
+    res = chain(x)
+    float(jnp.sum(res[0][:8].astype(jnp.float32)))  # dependency-chain gate
+    dt = (time.perf_counter() - t0) / iters
+    moved = (w + 2) * length * dtype.itemsize
+    return dt, moved / dt / 1e9
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_REDUCE_ROOFLINE.json"))
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--length", type=int, default=1 << 25)  # 128 MB f32
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print("no TPU attached; refusing to write a CPU 'roofline'")
+        return 1
+    peak = chip_peak_hbm_GBps()
+    rows = []
+    for w in (2, 4, 8):
+        for dtype_name in ("float32", "bfloat16"):
+            dt, gbps = measure_point(w, args.length, dtype_name, args.iters, 512)
+            rows.append(
+                {
+                    "w": w,
+                    "dtype": dtype_name,
+                    "length": args.length,
+                    "per_call_ms": round(dt * 1e3, 3),
+                    "achieved_GBps": round(gbps, 1),
+                    "frac_of_peak": round(gbps / peak, 3) if peak else None,
+                }
+            )
+            print(f"w={w} {dtype_name}: {gbps:.0f} GB/s"
+                  + (f" ({gbps / peak * 100:.0f}% of peak)" if peak else ""))
+    doc = {
+        "description": "pallas_reduce (local reduction, the allreduce hot "
+                       "loop) achieved HBM bandwidth vs chip roofline",
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "peak_hbm_GBps": peak,
+        "traffic_model": "(W+2) * L * itemsize per call (kernel (W+1)L + "
+                         "chain-gate DUS ~L)",
+        "results": rows,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
