@@ -27,9 +27,11 @@ Mapping from the reference:
 - ``ring_allreduce`` (``mpi_mod.hpp:1113-1163``) -> ``ppermute`` ring with
   the same decrementing block walk;
 - non-divisible counts: the reference clamps trailing blocks
-  (``mpi_mod.hpp:679-696``); XLA wants uniform shards, so we pad to
-  ``split_size * N`` (the reference's ``data_size_aligned``,
-  ``mpi_mod.hpp:232``) with the op's identity and slice the result back.
+  (``mpi_mod.hpp:679-696``); XLA wants uniform shards, so the first
+  ``(count//N)*N`` elements run through the scheduled collective unpadded
+  and the <N-element tail is reduced by one tiny dense collective
+  (``_split_main_tail`` — no full-buffer pad/slice copies, and buffer
+  donation stays intact).
 """
 
 from __future__ import annotations

@@ -83,13 +83,15 @@ double tree_cost(const uint32_t* widths, uint32_t k, const CostParams& p,
   return lat + bw + red + ctl;
 }
 
-// Ring allreduce cost — mirrors cost_model.py::ring_cost.
+// Ring allreduce cost — mirrors cost_model.py::ring_cost.  Launch is paid
+// per step: the ring is a fori_loop of 2(N-1) sequential per-step
+// collective dispatches, not one fused grouped collective per phase.
 double ring_cost(uint64_t n, const CostParams& p, double nbytes) {
   if (n <= 1) return 0.0;
   const double nd = static_cast<double>(n);
   const double steps = 2.0 * (nd - 1.0);
   const double per_step = nbytes / nd;
-  const double lat = steps * p.ici_latency_us + 2.0 * p.launch_us;
+  const double lat = steps * (p.ici_latency_us + p.launch_us);
   const double bw = steps * per_step / (p.ici_bw_GBps * 1e3);
   const double red = (nd - 1.0) / nd * nbytes / (p.reduce_bw_GBps * 1e3);
   return lat + bw + red;

@@ -93,10 +93,18 @@ def test_generic_op_tree_uses_ring_exchange():
     # traffic: per-hop message is tile/w elements.  stage0: 64/4=16 i32;
     # stage1 tile=16, w=2 -> 8 i32.  Both appear as collective_permute
     # operand types.
-    msgs = re.findall(
-        r'"stablehlo.collective_permute"\(%[\w#.]+\) <[^>]*> : \(tensor<(\d+)xi32>\)',
-        ir,
-    )
+    # The attribute dict between the operand list and the result type itself
+    # contains nested ``<...>`` (e.g. ``#stablehlo.channel_handle<handle = 1,
+    # type = 1>``), so don't try to span it with a regex — grab each
+    # collective_permute line and read the ``: (tensor<NxTY>)`` operand type
+    # at its end instead.
+    msgs = []
+    for line in ir.splitlines():
+        if '"stablehlo.collective_permute"' not in line:
+            continue
+        m = re.search(r":\s*\(tensor<(\d+)xi32>\)", line)
+        assert m, f"collective_permute line without i32 operand type: {line}"
+        msgs.append(m.group(1))
     assert sorted(int(m) for m in msgs) == [8, 16], msgs
 
 
