@@ -57,20 +57,17 @@ def tpu_alive(timeout_s: int = 120) -> bool:
         return False
 
 
-def _chained_s(fn, q, k, v, n_calls: int) -> float:
-    """Per-call seconds, execution forced by data dependency (shared
-    helper: ``flextree_tpu.utils.timing.time_chained``)."""
-    sys.path.insert(0, REPO)
-    from flextree_tpu.utils.timing import time_chained
-
-    return time_chained(fn, q, k, v, n_calls=n_calls)
-
-
 def bench_tpu_kernel() -> dict:
     """Our autotuned Pallas flash attention vs the strongest available
-    baseline: the stock Pallas TPU flash kernel (falling back to XLA
-    full-matrix attention if stock fails on this backend).  Reports MFU
-    against the chip's bf16 peak alongside TFLOP/s (VERDICT r1 item 3)."""
+    baseline: the stock Pallas TPU flash kernel, ALSO autotuned and timed
+    in its native (B, H, T, D) layout (falling back to XLA full-matrix
+    attention if stock fails on this backend).  Both sides use the
+    device-loop timing protocol (``time_device_loop``): per-call time is
+    the slope of an in-jit chained fori_loop at two iteration counts,
+    which cancels the tunneled backend's per-dispatch latency — the r01/r02
+    numbers (45/33 TFLOP/s) were dominated by that latency, not by the
+    kernel, whose device time is ~95 TFLOP/s (PROFILE_ATTENTION.md).
+    Reports MFU against the chip's bf16 peak alongside TFLOP/s."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -80,20 +77,18 @@ def bench_tpu_kernel() -> dict:
         AttentionBenchConfig,
         autotune_attention,
         chip_peak_tflops,
-        run_attention_bench,
     )
     from flextree_tpu.parallel.ring_attention import attention_reference
+    from flextree_tpu.utils.timing import time_device_loop
 
     b, t, h, d = 4, 4096, 16, 128
     cfg = AttentionBenchConfig(batch=b, seq_len=t, heads=h, head_dim=d)
-    ours = autotune_attention(cfg, repeat=15)
+    ours = autotune_attention(cfg)
 
-    baseline_name = "stock_pallas_flash"
+    baseline_name = "stock_pallas_flash_tuned"
     try:
-        base = run_attention_bench(
-            AttentionBenchConfig(
-                batch=b, seq_len=t, heads=h, head_dim=d, impl="stock", repeat=10
-            )
+        base = autotune_attention(
+            cfg, impl="stock", blocks=((1024, 512), (512, 512))
         )
         base_tflops = base.tflops
     except Exception:
@@ -113,10 +108,10 @@ def bench_tpu_kernel() -> dict:
             return 4 * batch * h * t * t * d / 2  # causal
 
         try:
-            base_s = _chained_s(ref, q, k, v, n_calls=10)
+            base_s = time_device_loop(ref, q, k, v)
             base_tflops = flops_for(b) / base_s / 1e12
         except Exception:
-            base_s = _chained_s(ref, q[:1], k[:1], v[:1], n_calls=10)
+            base_s = time_device_loop(ref, q[:1], k[:1], v[:1])
             base_tflops = flops_for(1) / base_s / 1e12
 
     out = {
@@ -128,6 +123,7 @@ def bench_tpu_kernel() -> dict:
         "baseline": baseline_name,
         "baseline_tflops": round(base_tflops, 2),
         "blocks": [ours.config.block_q, ours.config.block_k],
+        "timing": "device_loop_slope",
     }
     peak = chip_peak_tflops()
     if peak:
@@ -154,10 +150,15 @@ def bench_cpu_allreduce() -> dict:
     from flextree_tpu.planner import fit_cost_params, measure_points
 
     points = measure_points(
-        ["8", "4,2", "2,2,2", "1"], [1 << 16, 1 << 19], repeat=3, devices=8
+        ["8", "4,2", "2,2,2", "1"], [1 << 16, 1 << 19], repeat=10, devices=8
     )
-    params = fit_cost_params(points)
-    plan = choose_topology(8, size * 4, params=params)
+    try:
+        params = fit_cost_params(points)
+        plan = choose_topology(8, size * 4, params=params)
+    except RuntimeError:
+        # degenerate NNLS fit (measurements too noisy to be consistent with
+        # the model): fall back to the default constants rather than dying
+        plan = choose_topology(8, size * 4)
     ours = run_allreduce_bench(
         BenchConfig(
             size=size, repeat=10, comm_type="flextree", topo=plan.to_ft_topo()

@@ -52,10 +52,17 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--autotune", action="store_true",
-        help="sweep (block_q, block_k) in {256,512,1024}^2 (flash impl only)",
+        help="sweep the shortlisted (block_q, block_k) pairs from the v5e "
+        "block sweep (works for flash and stock impls)",
     )
-    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-q", type=int, default=256)
     ap.add_argument("--block-k", type=int, default=512)
+    ap.add_argument(
+        "--attn-timing", choices=["device_loop", "chained"],
+        default="device_loop",
+        help="device_loop: in-jit fori_loop slope (device time only, immune "
+        "to dispatch latency); chained: per-call python loop (includes it)",
+    )
     ap.add_argument(
         "--attn-dtype",
         type=str,
@@ -97,9 +104,11 @@ def main(argv=None) -> int:
             repeat=args.repeat,
             block_q=args.block_q,
             block_k=args.block_k,
+            timing=args.attn_timing,
         )
         if args.autotune:
-            report = autotune_attention(acfg, repeat=args.repeat)
+            report = autotune_attention(acfg, repeat=args.repeat,
+                                        impl=args.attn_impl)
         else:
             report = run_attention_bench(
                 acfg, tag=args.tag, to_file=args.to_file, out_dir=args.out_dir

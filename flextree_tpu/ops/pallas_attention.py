@@ -37,6 +37,7 @@ __all__ = ["flash_attention", "attention_with_offsets"]
 
 _NEG_INF = -1e30
 _LANE = 128  # lse is lane-replicated to satisfy Mosaic's (8, 128) block rule
+_LOG2E = 1.4426950408889634
 
 # forward k-loop unroll factor (env-overridable for tuning experiments);
 # measured neutral-to-slightly-negative on v5e at the benchmark shape, so
@@ -85,7 +86,12 @@ def _flash_kernel(
     unroll: int = 1,
 ):
     i = pl.program_id(1)
-    q = q_ref[0]  # (bq, D), native dtype — bf16 q/k feed the MXU directly
+    # fold scale*log2(e) into q once (bq x D) instead of scaling each
+    # (bq x bk) score tile, and run the online softmax in the exp2 domain —
+    # softmax is base-invariant when max/normalizer use the same base.
+    # Together with the full/masked loop split below this lifted the v5e
+    # benchmark shape from 83 to ~95 TFLOP/s (see PROFILE_ATTENTION.md).
+    q = q_ref[0] * (scale * _LOG2E)  # native dtype — bf16 q/k feed the MXU
     d = q.shape[-1]
     n_kb = t_kv // block_k
 
@@ -93,17 +99,51 @@ def _flash_kernel(
         # highest visible k position for this q tile (exclusive)
         hi = q_offset + (i + 1) * block_q - k_offset
         kb_hi = jnp.clip((hi + block_k - 1) // block_k, 0, n_kb)
+        # tiles fully visible to every row of this q tile need no mask:
+        # the first row (qpos = q_offset + i*block_q) sees `lo_vis` leading
+        # k positions, so tiles strictly inside that prefix skip the
+        # iota/compare/select entirely
+        lo_vis = q_offset + i * block_q - k_offset + 1
+        kb_full = jnp.clip(lo_vis // block_k, 0, n_kb)
     else:
         kb_hi = n_kb
+        kb_full = n_kb
+    if t_kv_valid < t_kv:  # static: only tiles before the pad are mask-free
+        kb_full = jnp.minimum(kb_full, t_kv_valid // block_k)
 
-    def step(j, carry):
-        m, l, acc = carry
+    def tile(j):
         kb = k_ref[0, pl.ds(j * block_k, block_k), :]
         vb = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk) f32 scores from native-dtype operands
+        )  # (bq, bk) f32 log2-domain scores from native-dtype operands
+        return s, vb
+
+    def update(carry, s, vb, valid=None):
+        m, l, acc = carry
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp2(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        # probabilities drop to v's dtype for the MXU (standard flash
+        # practice; exact when v is f32, ~1e-2 abs err in bf16)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    def step_full(j, carry):
+        s, vb = tile(j)
+        return update(carry, s, vb)
+
+    def step_masked(j, carry):
+        s, vb = tile(j)
         kpos = (
             k_offset
             + j * block_k
@@ -117,31 +157,26 @@ def _flash_kernel(
                 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             )
             valid = valid & (qpos >= kpos)
-        s = jnp.where(valid, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(valid, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        # probabilities drop to v's dtype for the MXU (standard flash
-        # practice; exact when v is f32, ~1e-2 abs err in bf16)
-        acc_new = acc * corr + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc_new
+        return update(carry, s, vb, valid=valid)
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, kb_hi, step, (m0, l0, acc0), unroll=unroll)
+    carry = lax.fori_loop(0, kb_full, step_full, (m0, l0, acc0), unroll=unroll)
+    m, l, acc = lax.fori_loop(kb_full, kb_hi, step_masked, carry)
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
     if maybe_lse_ref:  # only the differentiated path pays for the lse store
-        # fully-masked rows get a +inf-like sentinel so the backward's
-        # exp(s - lse) is exactly zero for them; the value is replicated
-        # across the 128-lane minor dim (Mosaic block constraint)
-        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-38)), -_NEG_INF)
+        # lse is stored in NATURAL-log units (m is log2-domain: divide the
+        # whole thing by log2(e)); fully-masked rows get a +inf-like
+        # sentinel so the backward's exp(s - lse) is exactly zero for them;
+        # the value is replicated across the 128-lane minor dim (Mosaic
+        # block constraint)
+        lse = jnp.where(
+            l > 0,
+            (m + jnp.log2(jnp.maximum(l, 1e-38))) * (1.0 / _LOG2E),
+            -_NEG_INF,
+        )
         maybe_lse_ref[0][0] = jnp.broadcast_to(lse, (block_q, _LANE))
 
 
@@ -232,40 +267,31 @@ def _flash_bwd_dq_kernel(
 ):
     dq_ref = rest[-1]
     i = pl.program_id(1)
-    q = q_ref[0]
+    # prescale q into the log2 domain (see _flash_kernel); the raw k tile
+    # still feeds the final ds @ k matmul, so dq's chain-rule `* scale`
+    # at the end is unchanged
+    qs = q_ref[0] * (scale * _LOG2E)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, 0:1]  # (bq, 1) — lane-replicated storage
+    # residual lse is natural-log; shift it into the log2 domain once
+    lse2 = lse_ref[0][:, 0:1] * _LOG2E  # (bq, 1) — lane-replicated storage
     # cotangent of the lse output; operand only exists when it was consumed
     glse = rest[0][0][:, 0:1] if has_glse else 0.0
     # delta_i = dout_i . out_i (the softmax-normalizer term)
     delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
-    d = q.shape[-1]
+    d = qs.shape[-1]
     n_kb = t_kv // block_k
     if causal:
         hi = q_offset + (i + 1) * block_q - k_offset
         kb_hi = jnp.clip((hi + block_k - 1) // block_k, 0, n_kb)
+        lo_vis = q_offset + i * block_q - k_offset + 1
+        kb_full = jnp.clip(lo_vis // block_k, 0, n_kb)
     else:
         kb_hi = n_kb
+        kb_full = n_kb
+    if t_kv_valid < t_kv:
+        kb_full = jnp.minimum(kb_full, t_kv_valid // block_k)
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        kpos = (
-            k_offset + j * block_k
-            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        )
-        valid = kpos - k_offset < t_kv_valid
-        if causal:
-            qpos = (
-                q_offset + i * block_q
-                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            )
-            valid = valid & (qpos >= kpos)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    def tile_dq(j, dq, p, kb, vb):
         dp = jax.lax.dot_general(
             do, vb.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -277,19 +303,54 @@ def _flash_bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    dq = lax.fori_loop(0, kb_hi, body, jnp.zeros((block_q, d), jnp.float32))
+    def loads(j):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            qs, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # log2-domain scores
+        return s, kb, vb
+
+    def body_full(j, dq):
+        s, kb, vb = loads(j)
+        return tile_dq(j, dq, jnp.exp2(s - lse2), kb, vb)
+
+    def body_masked(j, dq):
+        s, kb, vb = loads(j)
+        kpos = (
+            k_offset + j * block_k
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        )
+        valid = kpos - k_offset < t_kv_valid
+        if causal:
+            qpos = (
+                q_offset + i * block_q
+                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            valid = valid & (qpos >= kpos)
+        p = jnp.where(valid, jnp.exp2(s - lse2), 0.0)
+        return tile_dq(j, dq, p, kb, vb)
+
+    dq = lax.fori_loop(0, kb_full, body_full, jnp.zeros((block_q, d), jnp.float32))
+    dq = lax.fori_loop(kb_full, kb_hi, body_masked, dq)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *rest,
-    has_glse, block_q, block_k, t_q, t_kv_valid, causal, scale,
+    has_glse, block_q, block_k, t_q, t_kv, t_kv_valid, causal, scale,
     q_offset, k_offset,
 ):
     glse_ref = rest[0] if has_glse else None
     dk_ref, dv_ref = rest[-2], rest[-1]
     j = pl.program_id(1)
     kb = k_ref[0]
+    # log2-domain prescale lives on the k tile here (q appears raw in the
+    # final ds^T @ q matmul, so prescaling q would corrupt dk); one
+    # (bk x D) multiply per grid step replaces a (bq x bk) score scale per
+    # q tile
+    kbs = kb * (scale * _LOG2E)
     vb = v_ref[0]
     d = kb.shape[-1]
     n_qb = t_q // block_q
@@ -297,8 +358,13 @@ def _flash_bwd_dkv_kernel(
         # first q tile whose last row can see this k tile
         lo = (k_offset + j * block_k - q_offset) // block_q
         qb_lo = jnp.clip(lo, 0, n_qb)
+        # first q tile whose FIRST row sees the whole k tile — from there
+        # on no causal mask is needed
+        full_lo = -(-(k_offset + (j + 1) * block_k - 1 - q_offset) // block_q)
+        qb_full_lo = jnp.clip(full_lo, 0, n_qb)
     else:
         qb_lo = 0
+        qb_full_lo = 0
 
     kpos = (
         k_offset + j * block_k
@@ -306,28 +372,23 @@ def _flash_bwd_dkv_kernel(
     )
     k_valid = kpos - k_offset < t_kv_valid
 
-    def body(i, carry):
-        dk, dv = carry
+    def tiles(i):
         qb = q_ref[0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         ob = o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]  # (bq, 1)
+        lse2 = lse_ref[0, pl.ds(i * block_q, block_q), 0:1] * _LOG2E
         glse = (
             glse_ref[0, pl.ds(i * block_q, block_q), 0:1] if has_glse else 0.0
         )
         delta = jnp.sum(do * ob, axis=-1, keepdims=True)
         s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
+            qb, kbs, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        valid = k_valid
-        if causal:
-            qpos = (
-                q_offset + i * block_q
-                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            )
-            valid = valid & (qpos >= kpos)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        )  # log2-domain scores
+        return qb, do, lse2, glse, delta, s
+
+    def accumulate(carry, qb, do, glse, delta, p):
+        dk, dv = carry
         dv = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -343,9 +404,30 @@ def _flash_bwd_dkv_kernel(
         )
         return dk, dv
 
+    def body_masked(i, carry):
+        qb, do, lse2, glse, delta, s = tiles(i)
+        valid = k_valid
+        if causal:
+            qpos = (
+                q_offset + i * block_q
+                + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            valid = valid & (qpos >= kpos)
+        p = jnp.where(valid, jnp.exp2(s - lse2), 0.0)
+        return accumulate(carry, qb, do, glse, delta, p)
+
+    def body_full(i, carry):
+        qb, do, lse2, glse, delta, s = tiles(i)
+        return accumulate(carry, qb, do, glse, delta, jnp.exp2(s - lse2))
+
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = lax.fori_loop(qb_lo, n_qb, body, (dk0, dv0))
+    if t_kv_valid < t_kv:
+        # k padding present: every q tile needs the k-validity mask
+        dk, dv = lax.fori_loop(qb_lo, n_qb, body_masked, (dk0, dv0))
+    else:
+        carry = lax.fori_loop(qb_lo, qb_full_lo, body_masked, (dk0, dv0))
+        dk, dv = lax.fori_loop(qb_full_lo, n_qb, body_full, carry)
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -414,7 +496,8 @@ def _flash_bwd_impl(
         )
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, t_q=tq_pad, t_kv_valid=tk, **common
+            _flash_bwd_dkv_kernel, t_q=tq_pad, t_kv=tk_pad, t_kv_valid=tk,
+            **common,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, tk_pad, d), k.dtype),
@@ -536,8 +619,8 @@ def flash_attention(
     scale: float | None = None,
     q_offset: int = 0,
     k_offset: int = 0,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     interpret: bool | None = None,
     return_lse: bool = False,
 ):

@@ -17,7 +17,14 @@ from dataclasses import dataclass, field
 
 import jax
 
-__all__ = ["Timer", "BenchResult", "time_jax_fn", "time_jax_fn_inplace", "time_chained"]
+__all__ = [
+    "Timer",
+    "BenchResult",
+    "time_jax_fn",
+    "time_jax_fn_inplace",
+    "time_chained",
+    "time_device_loop",
+]
 
 
 class Timer:
@@ -123,6 +130,66 @@ def time_jax_fn_inplace(fn, x, repeat: int = 10, warmup: int = 2) -> BenchResult
         jax.block_until_ready(acc)
         times.append(t.stop())
     return BenchResult(tuple(times), compile_s)
+
+
+def time_device_loop(
+    fn,
+    x0,
+    *rest,
+    n_lo: int = 2,
+    n_hi: int = 12,
+    best_of: int = 4,
+) -> float:
+    """Device-only per-call seconds for ``fn(x0, *rest)`` via an in-jit
+    chained loop at two iteration counts.
+
+    Protocol: jit ``lax.fori_loop(0, n, lambda i, a: fn(a, *rest), x0)``
+    followed by a host scalar fetch, at ``n_lo`` and ``n_hi`` iterations;
+    per-call time is the slope ``(t_hi - t_lo) / (n_hi - n_lo)`` with each
+    endpoint the best of ``best_of`` runs.  The output→input chain makes
+    every iteration data-dependent (unfakeable by an async backend) and the
+    slope cancels the *fixed* dispatch cost per jit call — which over this
+    container's tunneled TPU is tens of milliseconds and swings 2-4x
+    run-to-run, enough to bury the kernel entirely (r02 reported 33 TFLOP/s
+    for a kernel whose device time is ~95; see PROFILE_ATTENTION.md).
+    Requires ``fn``'s output to match its first argument in shape/dtype.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make_loop(n):
+        def loop(x, *r):
+            acc = lax.fori_loop(0, n, lambda i, a: fn(a, *r), x)
+            return jnp.sum(acc.astype(jnp.float32))
+
+        return jax.jit(loop)
+
+    loop_lo, loop_hi = make_loop(n_lo), make_loop(n_hi)
+    float(loop_lo(x0, *rest))  # compile + warm
+    float(loop_hi(x0, *rest))
+
+    def best(loop):
+        b = float("inf")
+        for _ in range(best_of):
+            t0 = time.perf_counter()
+            float(loop(x0, *rest))
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    # dispatch noise can exceed the added work when fn is tiny, making the
+    # slope non-positive; retry with more best-of samples before giving up
+    # loudly rather than returning a <=0 "time" (which would publish as a
+    # negative/infinite TFLOP/s)
+    for attempt in range(3):
+        slope = (best(loop_hi) - best(loop_lo)) / (n_hi - n_lo)
+        if slope > 0:
+            return slope
+        best_of *= 2
+    raise RuntimeError(
+        f"time_device_loop: non-positive slope ({slope:.3e}s) after 3 "
+        f"attempts — fn is too small relative to dispatch noise at "
+        f"n_hi={n_hi}; raise n_hi or time it with time_jax_fn"
+    )
 
 
 def time_chained(fn, q, *rest, n_calls: int = 10) -> float:
