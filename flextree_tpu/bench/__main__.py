@@ -58,6 +58,11 @@ def main(argv=None) -> int:
     ap.add_argument("--block-q", type=int, default=256)
     ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument(
+        "--attn-mode", choices=["fwd", "grad"], default="fwd",
+        help="grad: time d/dq of sum(attention) — the fwd-with-lse pass "
+        "plus both blockwise backward kernels (hw FLOPs incl. recompute)",
+    )
+    ap.add_argument(
         "--attn-timing", choices=["device_loop", "chained"],
         default="device_loop",
         help="device_loop: in-jit fori_loop slope (device time only, immune "
@@ -94,21 +99,23 @@ def main(argv=None) -> int:
             run_attention_bench,
         )
 
-        acfg = AttentionBenchConfig(
+        acfg_kw = dict(
             batch=args.batch,
             seq_len=args.seq_len,
             heads=args.heads,
             head_dim=args.head_dim,
             dtype=args.attn_dtype,
             impl=args.attn_impl,
-            repeat=args.repeat,
             block_q=args.block_q,
             block_k=args.block_k,
             timing=args.attn_timing,
+            mode=args.attn_mode,
         )
+        if args.attn_timing == "chained":
+            acfg_kw["repeat"] = args.repeat  # device_loop ignores repeat
+        acfg = AttentionBenchConfig(**acfg_kw)
         if args.autotune:
-            report = autotune_attention(acfg, repeat=args.repeat,
-                                        impl=args.attn_impl)
+            report = autotune_attention(acfg, impl=args.attn_impl)
         else:
             report = run_attention_bench(
                 acfg, tag=args.tag, to_file=args.to_file, out_dir=args.out_dir

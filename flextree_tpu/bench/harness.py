@@ -249,6 +249,13 @@ class AttentionBenchConfig:
     # PROFILE_ATTENTION.md).  "chained": per-call python loop with a final
     # fetch — includes dispatch overhead; kept for comparison/CPU tests.
     timing: str = "device_loop"
+    # "fwd": forward only.  "grad": d/dq of sum(attention) — exercises the
+    # forward-with-lse plus both blockwise backward kernels; reported
+    # FLOPs are hardware FLOPs (4.5x fwd: 2 fwd + 3 dq-kernel + 4
+    # dkv-kernel matmuls over the same visible tile set, recompute
+    # included).  flash/reference only — the stock kernel's bwd needs
+    # segment_ids plumbing we don't benchmark.
+    mode: str = "fwd"
 
 
 #: bf16 peak TFLOP/s by TPU generation (device_kind substring -> peak),
@@ -291,6 +298,7 @@ class AttentionBenchReport:
         return {
             "bench": "attention",
             "impl": self.config.impl,
+            "mode": self.config.mode,
             "batch": self.config.batch,
             "seq_len": self.config.seq_len,
             "heads": self.config.heads,
@@ -318,14 +326,24 @@ def run_attention_bench(
     from ..parallel.ring_attention import attention_reference
 
     layout_bhtd = False  # stock kernel's native layout is (B, H, T, D)
+    if cfg.mode not in ("fwd", "grad"):
+        raise ValueError(f"unknown mode {cfg.mode!r} (fwd|grad)")
+    if cfg.mode == "grad" and cfg.impl == "stock":
+        raise ValueError("mode='grad' supports impl flash|reference only")
     if cfg.impl == "flash":
-        fn = jax.jit(
-            lambda q, k, v: flash_attention(
-                q, k, v, causal=True, block_q=cfg.block_q, block_k=cfg.block_k
-            )
+        core = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=True, block_q=cfg.block_q, block_k=cfg.block_k
         )
+        if cfg.mode == "grad":
+            fn = jax.jit(jax.grad(lambda q, k, v: core(q, k, v).sum()))
+        else:
+            fn = jax.jit(core)
     elif cfg.impl == "reference":
-        fn = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
+        core = lambda q, k, v: attention_reference(q, k, v, causal=True)  # noqa: E731
+        if cfg.mode == "grad":
+            fn = jax.jit(jax.grad(lambda q, k, v: core(q, k, v).sum()))
+        else:
+            fn = jax.jit(core)
     elif cfg.impl == "stock":
         # the stock Pallas TPU flash kernel, measured FAIRLY: inputs are
         # generated directly in its native (B, H, T, D) layout (no timed
@@ -359,8 +377,15 @@ def run_attention_bench(
     )
     q, k, v = mk(), mk(), mk()
     if cfg.timing == "device_loop":
-        # note: cfg.repeat governs only the chained protocol; device_loop's
-        # sample counts are its n_lo/n_hi/best_of
+        # cfg.repeat governs only the chained protocol; device_loop's
+        # sample counts are its n_lo/n_hi/best_of — say so when the caller
+        # set a non-default repeat expecting it to matter
+        if cfg.repeat != type(cfg).repeat:
+            log.warning(
+                "timing='device_loop' ignores repeat=%d (fixed slope "
+                "protocol); use timing='chained' if you want a repeat loop",
+                cfg.repeat,
+            )
         per_call = time_device_loop(fn, q, k, v)
     elif cfg.timing == "chained":
         per_call = time_chained(fn, q, k, v, n_calls=cfg.repeat)
@@ -368,7 +393,8 @@ def run_attention_bench(
         raise ValueError(
             f"unknown timing {cfg.timing!r} (device_loop|chained)"
         )
-    flops = 4 * b * h * t * t * d / 2  # causal
+    grad_flop_scale = 4.5 if cfg.mode == "grad" else 1.0
+    flops = 4 * b * h * t * t * d / 2 * grad_flop_scale  # causal
     tflops = flops / per_call / 1e12
     peak = chip_peak_tflops()
     report = AttentionBenchReport(
@@ -376,7 +402,8 @@ def run_attention_bench(
     )
     log.info(
         "attention %s: %.3f ms/call, %.2f TFLOP/s%s",
-        cfg.impl, per_call * 1e3, report.tflops,
+        cfg.impl if cfg.mode == "fwd" else f"{cfg.impl}+grad",
+        per_call * 1e3, report.tflops,
         f" ({report.mfu * 100:.1f}% MFU)" if report.mfu is not None else "",
     )
     if to_file:
@@ -394,7 +421,7 @@ def run_attention_bench(
 def autotune_attention(
     cfg: AttentionBenchConfig,
     blocks: tuple[tuple[int, int], ...] = ((256, 512), (512, 512), (512, 1024)),
-    repeat: int = 8,
+    repeat: int | None = None,
     impl: str = "flash",
 ) -> AttentionBenchReport:
     """Sweep explicit (block_q, block_k) pairs and return the fastest
@@ -403,10 +430,21 @@ def autotune_attention(
     over the tunneled backend costs ~30 s, so the sweep is a shortlist,
     not a product.  Works for ``impl="stock"`` too (block_k_major is
     derived in ``run_attention_bench``)."""
+    if impl == "stock" and cfg.mode == "grad":
+        # fail here, not once per block pair — the per-combo `except` below
+        # would swallow the real error into "no configuration succeeded"
+        raise ValueError("mode='grad' supports impl flash|reference only")
+    rep_kw = {} if repeat is None else {"repeat": repeat}
+    if impl == "reference":
+        # block sizes don't reach attention_reference; sweeping them would
+        # re-run the identical benchmark len(blocks) times
+        return run_attention_bench(
+            dataclasses.replace(cfg, impl=impl, **rep_kw)
+        )
     best = None
     for bq, bk in blocks:
         c = dataclasses.replace(cfg, impl=impl, block_q=bq, block_k=bk,
-                                repeat=repeat)
+                                **rep_kw)
         try:
             r = run_attention_bench(c)
         except Exception as e:  # noqa: BLE001 — a block combo may not fit

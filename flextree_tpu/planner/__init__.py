@@ -22,10 +22,13 @@ from .cost_model import (
 )
 from .calibrate import (
     MeasuredPoint,
+    default_params,
     feature_vector,
     fit_cost_params,
+    load_calibration,
     measure_points,
     predict_us,
+    save_calibration,
     spearman,
 )
 from .choose import Candidate, Plan, candidate_topologies, choose_topology
@@ -58,6 +61,9 @@ __all__ = [
     "fit_cost_params",
     "predict_us",
     "spearman",
+    "save_calibration",
+    "load_calibration",
+    "default_params",
     "Candidate",
     "Plan",
     "candidate_topologies",

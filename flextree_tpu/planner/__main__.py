@@ -41,6 +41,20 @@ def main(argv=None) -> int:
     ap.add_argument("--ici-gbps", type=float, default=45.0)
     ap.add_argument("--ici-latency-us", type=float, default=1.0)
     ap.add_argument(
+        "--calibration",
+        type=str,
+        default=None,
+        metavar="PATH",
+        help="CALIBRATION.json with measured cost constants (see "
+        "planner/calibrate.py); overrides --ici-* when its section exists",
+    )
+    ap.add_argument(
+        "--backend",
+        type=str,
+        default="cpu",
+        help="which CALIBRATION.json section to load (cpu, tpu_v5e, ...)",
+    )
+    ap.add_argument(
         "--sweep",
         type=int,
         default=None,
@@ -57,6 +71,18 @@ def main(argv=None) -> int:
     params = TpuCostParams(
         ici=LinkParams(bandwidth_GBps=args.ici_gbps, latency_us=args.ici_latency_us)
     )
+    if args.calibration:
+        from .calibrate import load_calibration
+
+        cal = load_calibration(args.calibration, backend=args.backend)
+        if cal is None:
+            print(
+                f"no {args.backend!r} section in {args.calibration}; "
+                "using CLI/default constants",
+                file=sys.stderr,
+            )
+        else:
+            params = cal
     nbytes = int(args.size_mb * 1e6)
 
     if args.sweep is not None:

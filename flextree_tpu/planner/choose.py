@@ -119,7 +119,7 @@ def candidate_topologies(n: int) -> list[tuple[int, ...]]:
 def choose_topology(
     n: int,
     nbytes: int,
-    params: TpuCostParams = TpuCostParams(),
+    params: TpuCostParams | None = None,
     mesh_shape: tuple[int, ...] | None = None,
     dcn_axes: tuple[int, ...] = (),
 ) -> Plan:
@@ -134,6 +134,13 @@ def choose_topology(
     """
     if n < 1:
         raise ValueError(f"n must be >= 1, got {n}")
+    if params is None:
+        # measured constants from $FLEXTREE_CALIBRATION when present
+        # (per-backend CALIBRATION.json, see planner/calibrate.py), else
+        # the documented v5e-flavored defaults
+        from .calibrate import default_params
+
+        params = default_params()
     if dcn_axes and not mesh_shape:
         raise ValueError("dcn_axes requires mesh_shape (which axes are DCN?)")
     if mesh_shape:

@@ -200,3 +200,49 @@ def test_attention_bench_rejects_unknown_impl():
 
     with pytest.raises(ValueError, match="impl"):
         run_attention_bench(AttentionBenchConfig(impl="nope", repeat=1))
+
+
+def test_time_device_loop_measures_slope():
+    """The slope protocol returns a positive per-call time that scales with
+    the work, and rejects an output-shape-changing fn at trace time."""
+    import jax
+    import jax.numpy as jnp
+
+    from flextree_tpu.utils.timing import time_device_loop
+
+    x = jnp.ones((64, 64), jnp.float32)
+    light = lambda a: a * 1.000001  # noqa: E731
+    heavy = jax.jit(lambda a: (a @ a.T) * 1e-3 + a)
+    t_light = time_device_loop(light, x, n_lo=2, n_hi=64, best_of=3)
+    t_heavy = time_device_loop(heavy, x, n_lo=2, n_hi=64, best_of=3)
+    assert t_light > 0 and t_heavy > 0
+
+    import pytest
+
+    bad = lambda a: jnp.concatenate([a, a])  # noqa: E731 — shape grows
+    with pytest.raises(Exception):
+        time_device_loop(bad, x)
+
+
+def test_attention_bench_grad_mode():
+    from flextree_tpu.bench.harness import (
+        AttentionBenchConfig,
+        run_attention_bench,
+    )
+
+    rep = run_attention_bench(
+        AttentionBenchConfig(
+            batch=1, seq_len=32, heads=2, head_dim=16, dtype="float32",
+            impl="flash", mode="grad", repeat=1, block_q=16, block_k=16,
+            timing="chained",
+        )
+    )
+    assert rep.per_call_s > 0 and rep.tflops > 0
+    assert rep.payload()["mode"] == "grad"
+
+    import pytest
+
+    with pytest.raises(ValueError, match="grad"):
+        run_attention_bench(
+            AttentionBenchConfig(impl="stock", mode="grad", repeat=1)
+        )

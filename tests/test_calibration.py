@@ -112,3 +112,98 @@ def test_fit_recovers_synthetic_constants():
     for p in pts:
         got = predict_us(fit, p.widths, p.num_nodes, p.nbytes)
         assert abs(got - p.measured_us) <= 0.05 * p.measured_us + 1.0
+
+
+# ---------------------------------------------------------------- persistence
+
+
+def test_calibration_roundtrip(tmp_path):
+    """save_calibration/load_calibration preserve every constant, per
+    backend, and merge sections instead of clobbering the file."""
+    from flextree_tpu.planner import (
+        LinkParams,
+        TpuCostParams,
+        load_calibration,
+        save_calibration,
+    )
+
+    path = tmp_path / "CALIBRATION.json"
+    p_cpu = TpuCostParams(
+        ici=LinkParams(1.25, 10.0), dcn=LinkParams(1.25, 10.0),
+        reduce_bw_GBps=2.5, control_us_per_width=0.0, launch_us=61.4,
+    )
+    p_tpu = TpuCostParams(reduce_bw_GBps=600.0)
+    save_calibration(path, p_cpu, backend="cpu", meta={"src": "test"})
+    save_calibration(path, p_tpu, backend="tpu_v5e")
+    got_cpu = load_calibration(path, backend="cpu")
+    got_tpu = load_calibration(path, backend="tpu_v5e")
+    assert got_cpu == p_cpu
+    assert got_tpu == p_tpu
+    assert load_calibration(path, backend="nope") is None
+    assert load_calibration(tmp_path / "missing.json", backend="cpu") is None
+
+
+def test_choose_topology_loads_calibration_from_env(tmp_path, monkeypatch):
+    """With $FLEXTREE_CALIBRATION set, a bare choose_topology() prices with
+    the measured constants: a huge launch cost must steer the argmin to
+    the fewest-stage (flat) shape even at sizes where the invented
+    defaults would pick otherwise."""
+    from flextree_tpu.planner import (
+        LinkParams,
+        TpuCostParams,
+        choose_topology,
+        save_calibration,
+    )
+
+    path = tmp_path / "CALIBRATION.json"
+    # launch-dominated host (like this repo's 1-core CI): 10 ms per
+    # collective dwarfs everything else
+    save_calibration(
+        path,
+        TpuCostParams(
+            ici=LinkParams(1.0, 10.0), dcn=LinkParams(1.0, 10.0),
+            reduce_bw_GBps=2.0, control_us_per_width=0.0, launch_us=10_000.0,
+        ),
+        backend="cpu",
+    )
+    monkeypatch.setenv("FLEXTREE_CALIBRATION", str(path))
+    monkeypatch.setenv("FLEXTREE_CALIBRATION_BACKEND", "cpu")
+    plan = choose_topology(8, 1 << 22)
+    assert plan.widths == (8,), plan.summary()
+    # without the env var the same call uses the invented defaults and
+    # must NOT depend on the file's presence
+    monkeypatch.delenv("FLEXTREE_CALIBRATION")
+    base = choose_topology(8, 1 << 22)
+    assert base.summary() == choose_topology(8, 1 << 22).summary()
+
+
+def test_planner_cli_calibration_flag(tmp_path, capsys):
+    from flextree_tpu.planner import TpuCostParams, save_calibration
+    from flextree_tpu.planner.__main__ import main
+
+    path = tmp_path / "CALIBRATION.json"
+    save_calibration(
+        path, TpuCostParams(launch_us=10_000.0), backend="cpu"
+    )
+    rc = main(["--n", "8", "--size-mb", "4", "--calibration", str(path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "FT_TOPO=8" in out  # launch-dominated -> flat
+
+
+def test_load_calibration_platform_prefix_fallback(tmp_path):
+    """backend='tpu' (what jax.default_backend() says) must find the file's
+    more specific 'tpu_v5e' section — unless two tpu_* sections make the
+    choice ambiguous."""
+    from flextree_tpu.planner import (
+        TpuCostParams,
+        load_calibration,
+        save_calibration,
+    )
+
+    path = tmp_path / "CALIBRATION.json"
+    p = TpuCostParams(reduce_bw_GBps=612.0)
+    save_calibration(path, p, backend="tpu_v5e")
+    assert load_calibration(path, backend="tpu") == p
+    save_calibration(path, TpuCostParams(reduce_bw_GBps=1000.0), backend="tpu_v6e")
+    assert load_calibration(path, backend="tpu") is None  # ambiguous

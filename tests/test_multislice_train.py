@@ -104,7 +104,11 @@ def test_lowered_step_has_per_axis_grouped_collectives(setup):
         for m in re.finditer(r'"?stablehlo\.all_reduce"?[^\n]*', ir)
         if "[[0, 1, 2, 3, 4, 5, 6, 7]]" in m.group(0)
     ]
-    assert len(full) <= 1, f"{len(full)} flat 8-rank all_reduce ops (expect <=1)"
+    # exactly the loss psum: == 1 (not <= 1) also anchors the detector —
+    # if an MLIR printer change moved the attribute dict off the op's
+    # line, this would go to 0 and flag the regex instead of passing
+    # vacuously while a degenerated flat gradient sync slips by
+    assert len(full) == 1, f"{len(full)} flat 8-rank all_reduce ops (expect 1)"
 
 
 def test_psum_oracle_lowering_differs(setup):
