@@ -53,7 +53,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--autotune", action="store_true",
         help="sweep the shortlisted (block_q, block_k) pairs from the v5e "
-        "block sweep (works for flash and stock impls)",
+        "block sweep (flash/stock; reference runs once, blocks unused)",
     )
     ap.add_argument("--block-q", type=int, default=256)
     ap.add_argument("--block-k", type=int, default=512)

@@ -249,12 +249,12 @@ class AttentionBenchConfig:
     # PROFILE_ATTENTION.md).  "chained": per-call python loop with a final
     # fetch — includes dispatch overhead; kept for comparison/CPU tests.
     timing: str = "device_loop"
-    # "fwd": forward only.  "grad": d/dq of sum(attention) — exercises the
-    # forward-with-lse plus both blockwise backward kernels; reported
-    # FLOPs are hardware FLOPs (4.5x fwd: 2 fwd + 3 dq-kernel + 4
-    # dkv-kernel matmuls over the same visible tile set, recompute
-    # included).  flash/reference only — the stock kernel's bwd needs
-    # segment_ids plumbing we don't benchmark.
+    # "fwd": forward only.  "grad": d/dq of sum(attention) — for flash,
+    # exercises the forward-with-lse plus both blockwise backward kernels;
+    # reported FLOPs are per-impl hardware FLOPs (flash 4.5x fwd with
+    # recompute, reference 3x — see grad_flop_scale in
+    # run_attention_bench).  flash/reference only — the stock kernel's
+    # bwd needs segment_ids plumbing we don't benchmark.
     mode: str = "fwd"
 
 
@@ -334,16 +334,10 @@ def run_attention_bench(
         core = lambda q, k, v: flash_attention(  # noqa: E731
             q, k, v, causal=True, block_q=cfg.block_q, block_k=cfg.block_k
         )
-        if cfg.mode == "grad":
-            fn = jax.jit(jax.grad(lambda q, k, v: core(q, k, v).sum()))
-        else:
-            fn = jax.jit(core)
+        fn = None  # grad/fwd wrap below
     elif cfg.impl == "reference":
         core = lambda q, k, v: attention_reference(q, k, v, causal=True)  # noqa: E731
-        if cfg.mode == "grad":
-            fn = jax.jit(jax.grad(lambda q, k, v: core(q, k, v).sum()))
-        else:
-            fn = jax.jit(core)
+        fn = None
     elif cfg.impl == "stock":
         # the stock Pallas TPU flash kernel, measured FAIRLY: inputs are
         # generated directly in its native (B, H, T, D) layout (no timed
@@ -367,6 +361,11 @@ def run_attention_bench(
         )
     else:
         raise ValueError(f"unknown attention impl {cfg.impl!r}")
+    if fn is None:  # flash/reference share the grad/fwd wrap
+        if cfg.mode == "grad":
+            fn = jax.jit(jax.grad(lambda q, k, v: core(q, k, v).sum()))
+        else:
+            fn = jax.jit(core)
 
     b, t, h, d = cfg.batch, cfg.seq_len, cfg.heads, cfg.head_dim
     rng = np.random.default_rng(0)
@@ -393,7 +392,15 @@ def run_attention_bench(
         raise ValueError(
             f"unknown timing {cfg.timing!r} (device_loop|chained)"
         )
-    grad_flop_scale = 4.5 if cfg.mode == "grad" else 1.0
+    # hardware-FLOP scale for grad mode, per impl: the flash path re-runs
+    # the forward (custom_vjp) then 3 dq-kernel + 4 dkv-kernel matmuls over
+    # the visible tiles -> (2+3+4)/2 = 4.5x fwd; XLA autodiff of the
+    # full-matrix reference stores P and does 4 backward matmuls, no
+    # recompute -> (2+4)/2 = 3x fwd
+    if cfg.mode == "grad":
+        grad_flop_scale = 4.5 if cfg.impl == "flash" else 3.0
+    else:
+        grad_flop_scale = 1.0
     flops = 4 * b * h * t * t * d / 2 * grad_flop_scale  # causal
     tflops = flops / per_call / 1e12
     peak = chip_peak_tflops()

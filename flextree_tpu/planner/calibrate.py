@@ -307,7 +307,13 @@ def default_params(backend: str | None = None) -> TpuCostParams:
                 backend = jax.default_backend()
         except Exception:  # noqa: BLE001 — stay usable without a backend
             backend = None
-    return load_calibration(path, backend=backend or "cpu") or TpuCostParams()
+    if backend is None:
+        # UNRESOLVABLE backend: fall back to the invented defaults, not to
+        # some section — guessing (e.g. "cpu") would let 1-core-host
+        # constants silently price a TPU fabric, the exact failure the
+        # per-backend sections exist to prevent
+        return TpuCostParams()
+    return load_calibration(path, backend=backend) or TpuCostParams()
 
 
 def predict_us(params: TpuCostParams, widths, n: int, nbytes: int) -> float:

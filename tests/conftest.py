@@ -15,6 +15,15 @@ Gotchas in this container (axon TPU plugin):
   ``jax.devices()`` — conftest import time is early enough.
 """
 
+import os
+
+# hermeticity: a developer shell may export the planner-calibration env vars
+# (README suggests FLEXTREE_CALIBRATION=CALIBRATION.json); the golden
+# planner tests pin the invented defaults, so ambient calibration must not
+# leak into the suite
+os.environ.pop("FLEXTREE_CALIBRATION", None)
+os.environ.pop("FLEXTREE_CALIBRATION_BACKEND", None)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -170,11 +170,19 @@ def test_choose_topology_loads_calibration_from_env(tmp_path, monkeypatch):
     monkeypatch.setenv("FLEXTREE_CALIBRATION_BACKEND", "cpu")
     plan = choose_topology(8, 1 << 22)
     assert plan.widths == (8,), plan.summary()
-    # without the env var the same call uses the invented defaults and
-    # must NOT depend on the file's presence
+    # without the env var the same call must return to the invented
+    # defaults — compare against an EXPLICIT default-params plan so a
+    # regression that kept consulting the file cannot pass vacuously
     monkeypatch.delenv("FLEXTREE_CALIBRATION")
     base = choose_topology(8, 1 << 22)
-    assert base.summary() == choose_topology(8, 1 << 22).summary()
+    explicit = choose_topology(8, 1 << 22, params=TpuCostParams())
+    assert base.summary() == explicit.summary()
+    # a backend with no section (and no prefix match) must fall back to
+    # the invented defaults, never guess another section
+    monkeypatch.setenv("FLEXTREE_CALIBRATION", str(path))
+    from flextree_tpu.planner import default_params
+
+    assert default_params(backend="gpu") == TpuCostParams()
 
 
 def test_planner_cli_calibration_flag(tmp_path, capsys):

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Attention A/B artifact: ours (autotuned) vs tuned stock vs XLA
+full-matrix, all device-loop-slope timed, written to BENCH_ATTENTION.json.
+
+The reproducible generator behind PROFILE_ATTENTION.md §2-3's headline
+table.  Run on the real chip (takes ~5 min; ~10 jit compiles over the
+tunnel).  Each entry records per-call seconds, TFLOP/s on causal-attention
+FLOPs, and MFU against the chip's bf16 peak.
+
+Usage: python tools/bench_attention.py [--out BENCH_ATTENTION.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_ATTENTION.json"))
+    ap.add_argument("--samples", type=int, default=3,
+                    help="slope measurements per config; median reported")
+    args = ap.parse_args()
+
+    import jax
+
+    from flextree_tpu.bench.harness import (
+        AttentionBenchConfig,
+        chip_peak_tflops,
+        run_attention_bench,
+    )
+
+    dev = jax.devices()[0]
+    cfg = AttentionBenchConfig()  # b4 t4096 h16 d128 bf16 causal
+    peak = chip_peak_tflops()
+
+    def median_of(make_cfg):
+        reps = sorted(
+            (run_attention_bench(make_cfg()) for _ in range(args.samples)),
+            key=lambda r: r.tflops,
+        )
+        return reps[len(reps) // 2]
+
+    import dataclasses
+
+    entries = {}
+    for name, kw in {
+        "ours_256_512": dict(impl="flash", block_q=256, block_k=512),
+        "ours_512_512": dict(impl="flash", block_q=512, block_k=512),
+        "stock_tuned_1024_512": dict(impl="stock", block_q=1024, block_k=512),
+        "stock_default_shape_512": dict(impl="stock", block_q=512, block_k=512),
+        "xla_full_matrix": dict(impl="reference"),
+        "ours_grad_256_512": dict(
+            impl="flash", block_q=256, block_k=512, mode="grad"
+        ),
+    }.items():
+        try:
+            rep = median_of(lambda kw=kw: dataclasses.replace(cfg, **kw))
+            entries[name] = rep.payload()
+        except Exception as e:  # noqa: BLE001 — record the failure honestly
+            entries[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"{name}: {entries[name].get('tflops', 'FAIL')}", flush=True)
+
+    ours = entries.get("ours_256_512", {}).get("tflops")
+    stock = entries.get("stock_tuned_1024_512", {}).get("tflops")
+    doc = {
+        "description": "Causal bf16 attention A/B (B=4 T=4096 H=16 D=128), "
+        "device-loop slope timing (flextree_tpu.utils.timing."
+        "time_device_loop); median of per-config samples. See "
+        "PROFILE_ATTENTION.md for the protocol and ceiling analysis.",
+        "date": datetime.date.today().isoformat(),
+        "device": getattr(dev, "device_kind", str(dev)),
+        "chip_peak_bf16_tflops": peak,
+        "samples_per_config": args.samples,
+        "vs_tuned_stock": round(ours / stock, 3) if ours and stock else None,
+        "entries": entries,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -115,11 +115,24 @@ print("RESULT " + json.dumps({{
         save_calibration,
     )
 
+    # derive the section name from what was actually measured — committing
+    # v4 numbers under a "tpu_v5e" label would poison the prefix-fallback
+    # lookup on every other chip
+    kind = r["device"].lower()
+    for sub, name in (("v5 lite", "v5e"), ("v5litepod", "v5e"),
+                      ("v6 lite", "v6e"), ("v5p", "v5p"), ("v6e", "v6e"),
+                      ("v5e", "v5e"), ("v4", "v4"), ("v3", "v3")):
+        if sub in kind:
+            section = f"tpu_{name}"
+            break
+    else:
+        section = "tpu_" + "".join(c if c.isalnum() else "_" for c in kind)
+
     params = TpuCostParams(reduce_bw_GBps=round(r["achieved_GBps"], 1))
     save_calibration(
         out,
         params,
-        backend="tpu_v5e",
+        backend=section,
         meta={
             "date": datetime.date.today().isoformat(),
             "device": r["device"],
@@ -136,7 +149,7 @@ print("RESULT " + json.dumps({{
             },
         },
     )
-    print(f"tpu_v5e section written: reduce_bw={params.reduce_bw_GBps} GB/s")
+    print(f"{section} section written: reduce_bw={params.reduce_bw_GBps} GB/s")
     return True
 
 
