@@ -175,11 +175,52 @@ def bench_cpu_allreduce() -> dict:
     }
 
 
-def main() -> int:
+def bench_tpu_kernel_guarded(timeout_s: int = 1500) -> dict | None:
+    """Run the TPU bench in a subprocess with a hard timeout.
+
+    ``tpu_alive`` only proves the tunnel was up at probe time; it has been
+    observed to wedge MID-session (backend init or a compile hanging
+    indefinitely), and bench.py must never hang the driver.  Returns None
+    on timeout/crash so the caller can fall back to the CPU A/B.
+    """
     try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--tpu-child"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print("tpu bench timed out mid-run (tunnel wedged?); falling back "
+              "to the CPU A/B", file=sys.stderr)
+        return None
+    except (subprocess.SubprocessError, OSError) as e:
+        print(f"tpu bench child failed to launch: {e}", file=sys.stderr)
+        return None
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            return d
+    # crashed (not hung): preserve the diagnostic before the CPU fallback
+    print(f"tpu bench child exited rc={p.returncode} with no metric line; "
+          f"stderr tail: {p.stderr[-400:]}", file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    if "--tpu-child" in sys.argv:
+        # child mode: the actual TPU bench, unguarded (parent holds the
+        # timeout); emit the JSON line and exit
+        print(json.dumps(bench_tpu_kernel()))
+        return 0
+    try:
+        result = None
         if tpu_alive():
-            result = bench_tpu_kernel()
-        else:
+            result = bench_tpu_kernel_guarded()
+        if result is None:
             result = bench_cpu_allreduce()
     except Exception as e:  # never hang or die silently: emit a valid line
         result = {

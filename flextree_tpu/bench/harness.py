@@ -258,20 +258,43 @@ class AttentionBenchConfig:
     mode: str = "fwd"
 
 
-#: bf16 peak TFLOP/s by TPU generation (device_kind substring -> peak),
-#: for MFU reporting.  v5e ("v5 lite") ~197; v4 ~275; v5p ~459; v6e ~918.
-_TPU_PEAK_TFLOPS = (
-    ("v5 lite", 197.0),
-    ("v5litepod", 197.0),
-    ("v5e", 197.0),
-    ("v6 lite", 918.0),
-    ("v6e", 918.0),
-    ("v5p", 459.0),
-    ("v5", 459.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
+#: device_kind substring -> canonical generation name.  Order matters:
+#: most-specific first ("v5 lite" before bare "v5", which is how v5p can
+#: report itself).  Single source of truth for every consumer that keys
+#: off the chip generation (MFU peaks here; calibration section names in
+#: tools/calibrate_host.py) so the tables can't drift apart.
+_TPU_GENERATIONS = (
+    ("v5 lite", "v5e"),
+    ("v5litepod", "v5e"),
+    ("v5e", "v5e"),
+    ("v6 lite", "v6e"),
+    ("v6e", "v6e"),
+    ("v5p", "v5p"),
+    ("v5", "v5p"),
+    ("v4", "v4"),
+    ("v3", "v3"),
+    ("v2", "v2"),
 )
+
+#: bf16 peak TFLOP/s by generation, for MFU reporting.
+_TPU_PEAK_TFLOPS = {
+    "v5e": 197.0,
+    "v6e": 918.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
+
+
+def tpu_generation(device_kind: str) -> str | None:
+    """Canonical generation name ("v5e", "v5p", ...) for a device_kind
+    string, or None when unrecognized."""
+    kind = device_kind.lower()
+    for sub, gen in _TPU_GENERATIONS:
+        if sub in kind:
+            return gen
+    return None
 
 
 def chip_peak_tflops() -> float | None:
@@ -279,11 +302,8 @@ def chip_peak_tflops() -> float | None:
     dev = jax.devices()[0]
     if dev.platform == "cpu":
         return None
-    kind = getattr(dev, "device_kind", "").lower()
-    for sub, peak in _TPU_PEAK_TFLOPS:
-        if sub in kind:
-            return peak
-    return None
+    gen = tpu_generation(getattr(dev, "device_kind", ""))
+    return _TPU_PEAK_TFLOPS.get(gen) if gen else None
 
 
 @dataclass(frozen=True)

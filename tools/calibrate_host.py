@@ -117,16 +117,16 @@ print("RESULT " + json.dumps({{
 
     # derive the section name from what was actually measured — committing
     # v4 numbers under a "tpu_v5e" label would poison the prefix-fallback
-    # lookup on every other chip
-    kind = r["device"].lower()
-    for sub, name in (("v5 lite", "v5e"), ("v5litepod", "v5e"),
-                      ("v6 lite", "v6e"), ("v5p", "v5p"), ("v6e", "v6e"),
-                      ("v5e", "v5e"), ("v4", "v4"), ("v3", "v3")):
-        if sub in kind:
-            section = f"tpu_{name}"
-            break
-    else:
-        section = "tpu_" + "".join(c if c.isalnum() else "_" for c in kind)
+    # lookup on every other chip.  Shared normalizer with the MFU table so
+    # the two can't drift.
+    from flextree_tpu.bench.harness import tpu_generation
+
+    gen = tpu_generation(r["device"])
+    section = (
+        f"tpu_{gen}"
+        if gen
+        else "tpu_" + "".join(c if c.isalnum() else "_" for c in r["device"].lower())
+    )
 
     params = TpuCostParams(reduce_bw_GBps=round(r["achieved_GBps"], 1))
     save_calibration(

@@ -29,31 +29,28 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-#: HBM peak GB/s by device_kind substring (v5e 819, v4 1228, v5p 2765, v6e 1638)
-_TPU_PEAK_HBM = (
-    ("v5 lite", 819.0),
-    ("v5litepod", 819.0),
-    ("v5e", 819.0),
-    ("v6 lite", 1638.0),
-    ("v6e", 1638.0),
-    ("v5p", 2765.0),
-    ("v5", 2765.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-)
+#: HBM peak GB/s by generation (v5e 819, v4 1228, v5p 2765, v6e 1638);
+#: device_kind normalization shared with the MFU table via
+#: flextree_tpu.bench.harness.tpu_generation
+_TPU_PEAK_HBM = {
+    "v5e": 819.0,
+    "v6e": 1638.0,
+    "v5p": 2765.0,
+    "v4": 1228.0,
+    "v3": 900.0,
+}
 
 
 def chip_peak_hbm_GBps():
     import jax
 
+    from flextree_tpu.bench.harness import tpu_generation
+
     dev = jax.devices()[0]
     if dev.platform == "cpu":
         return None
-    kind = getattr(dev, "device_kind", "").lower()
-    for sub, peak in _TPU_PEAK_HBM:
-        if sub in kind:
-            return peak
-    return None
+    gen = tpu_generation(getattr(dev, "device_kind", ""))
+    return _TPU_PEAK_HBM.get(gen) if gen else None
 
 
 def measure_point(w: int, length: int, dtype_name: str, iters: int, rows_tile: int):
