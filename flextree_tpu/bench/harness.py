@@ -258,23 +258,7 @@ class AttentionBenchConfig:
     mode: str = "fwd"
 
 
-#: device_kind substring -> canonical generation name.  Order matters:
-#: most-specific first ("v5 lite" before bare "v5", which is how v5p can
-#: report itself).  Single source of truth for every consumer that keys
-#: off the chip generation (MFU peaks here; calibration section names in
-#: tools/calibrate_host.py) so the tables can't drift apart.
-_TPU_GENERATIONS = (
-    ("v5 lite", "v5e"),
-    ("v5litepod", "v5e"),
-    ("v5e", "v5e"),
-    ("v6 lite", "v6e"),
-    ("v6e", "v6e"),
-    ("v5p", "v5p"),
-    ("v5", "v5p"),
-    ("v4", "v4"),
-    ("v3", "v3"),
-    ("v2", "v2"),
-)
+from ..utils.device import tpu_generation  # dependency-free normalizer
 
 #: bf16 peak TFLOP/s by generation, for MFU reporting.
 _TPU_PEAK_TFLOPS = {
@@ -285,16 +269,6 @@ _TPU_PEAK_TFLOPS = {
     "v3": 123.0,
     "v2": 45.0,
 }
-
-
-def tpu_generation(device_kind: str) -> str | None:
-    """Canonical generation name ("v5e", "v5p", ...) for a device_kind
-    string, or None when unrecognized."""
-    kind = device_kind.lower()
-    for sub, gen in _TPU_GENERATIONS:
-        if sub in kind:
-            return gen
-    return None
 
 
 def chip_peak_tflops() -> float | None:

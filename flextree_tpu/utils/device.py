@@ -1,0 +1,39 @@
+"""Device-kind normalization — dependency-free (no jax import).
+
+Single source of truth for every consumer that keys off the TPU chip
+generation: MFU peaks (``bench/harness.py``), HBM roofline peaks
+(``tools/roofline_reduce.py``), and calibration section names
+(``tools/calibrate_host.py``).  Living here, the host-side tools can
+normalize a device string without paying the jax-based bench harness's
+import chain.
+"""
+
+from __future__ import annotations
+
+__all__ = ["TPU_GENERATIONS", "tpu_generation"]
+
+#: device_kind substring -> canonical generation name.  Order matters:
+#: most-specific first ("v5 lite" before bare "v5", which is how v5p can
+#: report itself).
+TPU_GENERATIONS = (
+    ("v5 lite", "v5e"),
+    ("v5litepod", "v5e"),
+    ("v5e", "v5e"),
+    ("v6 lite", "v6e"),
+    ("v6e", "v6e"),
+    ("v5p", "v5p"),
+    ("v5", "v5p"),
+    ("v4", "v4"),
+    ("v3", "v3"),
+    ("v2", "v2"),
+)
+
+
+def tpu_generation(device_kind: str) -> str | None:
+    """Canonical generation name ("v5e", "v5p", ...) for a device_kind
+    string, or None when unrecognized."""
+    kind = device_kind.lower()
+    for sub, gen in TPU_GENERATIONS:
+        if sub in kind:
+            return gen
+    return None

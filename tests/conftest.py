@@ -29,3 +29,22 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
+
+
+def topology_strategy(max_width: int = 16, max_n: int = 512):
+    """Shared hypothesis strategy: random ordered-factorization topologies
+    (used by test_schedule_properties.py and test_native_schedule.py)."""
+    import numpy as np
+    from hypothesis import strategies as st
+
+    from flextree_tpu.schedule.stages import Topology
+
+    @st.composite
+    def topologies(draw):
+        n_stages = draw(st.integers(1, 4))
+        widths = tuple(draw(st.integers(2, max_width)) for _ in range(n_stages))
+        if int(np.prod(widths)) > max_n:
+            widths = widths[:2]
+        return Topology(int(np.prod(widths)), widths)
+
+    return topologies()

@@ -79,3 +79,24 @@ def test_invalid_rank_rejected():
     widths = (ctypes.c_uint32 * 2)(4, 2)
     needed = ctypes.c_uint64(0)
     assert lib.ft_plan(8, 99, widths, 2, 1, None, 0, ctypes.byref(needed)) == -1
+
+
+# ---------------------------------------------------------- property fuzzing
+
+
+def test_native_plans_match_python_random_topologies():
+    """Hypothesis cross-validation: the C++ twin must agree with the Python
+    schedule generator on EVERY rank of arbitrary random topologies, not
+    just the hand-picked SHAPES above."""
+    from hypothesis import given, settings
+
+    from conftest import topology_strategy
+
+    @settings(max_examples=30, deadline=None)
+    @given(topology_strategy(max_width=9, max_n=256))
+    def check(t):
+        for r in range(t.num_nodes):
+            assert native_send_plan(t, r) == send_plan(t, r)
+            assert native_recv_plan(t, r) == recv_plan(t, r)
+
+    check()

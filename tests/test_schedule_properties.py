@@ -1,0 +1,70 @@
+"""Property-based schedule invariants (hypothesis over random topologies).
+
+The hand-written invariant tests in ``test_schedule.py`` pin specific
+widths; these generate arbitrary ordered factorizations (N up to 512,
+stage widths 2..16) and assert the §3.2 invariants hold for ALL of them:
+
+- the static validator accepts every well-formed topology (partition,
+  send/recv agreement, ownership convergence, phase-2 restoration);
+- the NumPy simulator — which executes the schedule block-by-block like
+  the reference's MPI engine (``mpi_mod.hpp:988-1060``) — produces the
+  allreduce result for random shapes, dtypes, and non-divisible counts;
+- ring degenerates correctly for any N.
+
+The reference had no tests at all (SURVEY §4); this is the rebuild's
+answer at the strength the schedule core deserves — it is the part whose
+bugs would silently corrupt gradients.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from flextree_tpu.backends import simulate_allreduce, simulate_ring_allreduce
+from flextree_tpu.schedule.validate import validate, validate_ring
+
+
+from conftest import topology_strategy
+
+
+@settings(max_examples=40, deadline=None)
+@given(topology_strategy())
+def test_validator_accepts_all_wellformed_topologies(topo):
+    stats = validate(topo)
+    assert stats.num_nodes == topo.num_nodes
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    topology_strategy(),
+    st.integers(1, 97),  # counts including awkward non-divisible ones
+    st.sampled_from([np.float64, np.float32, np.int32]),
+)
+def test_simulator_allreduces_any_topology_and_count(topo, count, dtype):
+    n = topo.num_nodes
+    rng = np.random.default_rng(count * n)
+    if np.issubdtype(dtype, np.floating):
+        data = rng.standard_normal((n, count)).astype(dtype)
+    else:
+        data = rng.integers(-50, 50, (n, count)).astype(dtype)
+    out = simulate_allreduce(data, topo)
+    want = np.tile(data.sum(0, dtype=dtype), (n, 1))
+    if np.issubdtype(dtype, np.floating):
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+    else:
+        np.testing.assert_array_equal(out, want)
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(2, 64), st.integers(1, 70))
+def test_ring_simulator_and_validator_any_n(n, count):
+    from flextree_tpu.ops.reduce import get_op
+
+    validate_ring(n)
+    rng = np.random.default_rng(n * 1000 + count)
+    data = rng.standard_normal((n, count))
+    out = simulate_ring_allreduce(data, get_op("sum"))
+    np.testing.assert_allclose(
+        out, np.tile(data.sum(0), (n, 1)), rtol=1e-5, atol=1e-5
+    )

@@ -81,10 +81,10 @@ sys.path.insert(0, {os.path.join(REPO, "tools")!r})
 from roofline_reduce import chip_peak_hbm_GBps, measure_point
 # the allreduce reduce term folds w copies; w=8 at 16 MB is the
 # representative point (BASELINE.md config sizes)
-r = measure_point(w=8, length=1 << 22, dtype_name="float32", iters=8,
-                  rows_tile=256)
+dt, gbps = measure_point(w=8, length=1 << 22, dtype_name="float32", iters=8,
+                         rows_tile=256)
 print("RESULT " + json.dumps({{
-    "achieved_GBps": r["achieved_GBps"],
+    "achieved_GBps": gbps,
     "peak_GBps": chip_peak_hbm_GBps(),
     "device": jax.devices()[0].device_kind,
 }}))
@@ -119,7 +119,7 @@ print("RESULT " + json.dumps({{
     # v4 numbers under a "tpu_v5e" label would poison the prefix-fallback
     # lookup on every other chip.  Shared normalizer with the MFU table so
     # the two can't drift.
-    from flextree_tpu.bench.harness import tpu_generation
+    from flextree_tpu.utils.device import tpu_generation
 
     gen = tpu_generation(r["device"])
     section = (

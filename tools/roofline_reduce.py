@@ -31,7 +31,7 @@ sys.path.insert(0, REPO)
 
 #: HBM peak GB/s by generation (v5e 819, v4 1228, v5p 2765, v6e 1638);
 #: device_kind normalization shared with the MFU table via
-#: flextree_tpu.bench.harness.tpu_generation
+#: flextree_tpu.utils.device.tpu_generation
 _TPU_PEAK_HBM = {
     "v5e": 819.0,
     "v6e": 1638.0,
@@ -44,7 +44,7 @@ _TPU_PEAK_HBM = {
 def chip_peak_hbm_GBps():
     import jax
 
-    from flextree_tpu.bench.harness import tpu_generation
+    from flextree_tpu.utils.device import tpu_generation
 
     dev = jax.devices()[0]
     if dev.platform == "cpu":
