@@ -1,0 +1,91 @@
+"""Traffic analysis of generated schedules: count the bytes each plan
+actually exchanges, per stage and across a slice boundary.
+
+This is the executable bridge between the schedule layer and the cost
+model: ``allreduce_cost`` *prices* a stage at ``(w-1)/w * S/g`` bytes per
+chip per phase (``planner/cost_model.py``), and the functions here *count*
+those bytes by walking the very ``send_plan``/``recv_plan`` operations the
+backends execute — so the model's bandwidth term can be pinned to the
+schedule with equality tests instead of trust
+(``tests/test_schedule_properties.py``), and WINS.md's DCN-traffic-
+reduction claim is measured on executed plans, not only on lowered HLO.
+
+The reference had no such analysis; its cost model and runtime were
+separate binaries that could silently disagree (SURVEY §1: "the planner is
+not linked into the runtime").
+
+Slice convention: ranks are slice-major (``parallel/launch.py``'s
+``hybrid_mesh``), so rank ``r`` lives in slice ``r // slice_size``.
+"""
+
+from __future__ import annotations
+
+from .blocks import BlockLayout
+from .plan import recv_plan, send_plan
+from .stages import Topology
+
+__all__ = ["stage_sent_bytes", "cross_slice_bytes"]
+
+
+def _op_bytes(op, layout: BlockLayout, itemsize: int) -> int:
+    return sum(layout.span(b)[1] for b in op.blocks) * itemsize
+
+
+def stage_sent_bytes(
+    topo: Topology, count: int, itemsize: int, rank: int
+) -> list[tuple[int, int]]:
+    """Per stage: (phase-1 bytes, phase-2 bytes) ``rank`` sends.
+
+    Phase 1 walks ``send_plan``; phase 2 replays the stages in reverse with
+    the roles swapped (SURVEY §3.2), i.e. the rank sends its *own* block
+    set — exactly the ops ``recv_plan`` lists.  Self-sends (peer == rank)
+    move no bytes and are skipped, as in the executors.
+    """
+    layout = BlockLayout(topo.num_nodes, count)
+    out = []
+    for s_ops, r_ops in zip(send_plan(topo, rank), recv_plan(topo, rank)):
+        p1 = sum(_op_bytes(o, layout, itemsize) for o in s_ops if o.peer != rank)
+        p2 = sum(_op_bytes(o, layout, itemsize) for o in r_ops if o.peer != rank)
+        out.append((p1, p2))
+    return out
+
+
+def cross_slice_bytes(
+    topo: Topology, count: int, itemsize: int, slice_size: int
+) -> dict:
+    """Bytes crossing the slice boundary, counted over every rank's plan.
+
+    Returns ``{"per_stage": [(p1, p2), ...], "total": int,
+    "per_chip_per_phase_worst": int}`` where a (sender, peer) exchange
+    counts iff ``sender // slice_size != peer // slice_size``.
+    ``per_chip_per_phase_worst`` is the largest single (rank, stage, phase)
+    contribution — the quantity the cost model prices against the DCN
+    link's per-chip injection bandwidth.
+    """
+    if slice_size < 1 or topo.num_nodes % slice_size:
+        raise ValueError(
+            f"slice_size {slice_size} must divide num_nodes {topo.num_nodes}"
+        )
+    layout = BlockLayout(topo.num_nodes, count)
+    n_stages = topo.num_stages
+    per_stage = [[0, 0] for _ in range(n_stages)]
+    worst = 0
+    for rank in range(topo.num_nodes):
+        sl = rank // slice_size
+        for i, (s_ops, r_ops) in enumerate(
+            zip(send_plan(topo, rank), recv_plan(topo, rank))
+        ):
+            for phase, ops in ((0, s_ops), (1, r_ops)):
+                contrib = sum(
+                    _op_bytes(o, layout, itemsize)
+                    for o in ops
+                    if o.peer != rank and o.peer // slice_size != sl
+                )
+                per_stage[i][phase] += contrib
+                worst = max(worst, contrib)
+    total = sum(p1 + p2 for p1, p2 in per_stage)
+    return {
+        "per_stage": [tuple(x) for x in per_stage],
+        "total": total,
+        "per_chip_per_phase_worst": worst,
+    }

@@ -43,8 +43,8 @@ def topology_strategy(max_width: int = 16, max_n: int = 512):
     def topologies(draw):
         n_stages = draw(st.integers(1, 4))
         widths = tuple(draw(st.integers(2, max_width)) for _ in range(n_stages))
-        if int(np.prod(widths)) > max_n:
-            widths = widths[:2]
+        while len(widths) > 1 and int(np.prod(widths)) > max_n:
+            widths = widths[:-1]  # drop stages until the cap is honored
         return Topology(int(np.prod(widths)), widths)
 
     return topologies()
