@@ -123,3 +123,35 @@ def test_cross_slice_traffic_shrinks_by_gap_factor():
     # the measured reduction is the gap factor g=4 (x the phase structure)
     assert flat["per_chip_per_phase_worst"] // tree["per_chip_per_phase_worst"] == 4
     assert flat["total"] // tree["total"] == 4
+
+
+import pytest
+
+
+@pytest.mark.parametrize("slice_size", [2, 4, 8])
+@pytest.mark.parametrize("n_slices", [2, 4, 8])
+def test_planner_dcn_marking_matches_counted_traffic(slice_size, n_slices):
+    """Three-module consistency: the stages choose_topology prices at DCN
+    (via _stage_axes over mesh_shape with dcn_axes) must be exactly the
+    stages whose plans move nonzero cross-slice bytes — for every aligned
+    candidate topology of the mesh."""
+    from flextree_tpu.planner.choose import _stage_axes, candidate_topologies
+    from flextree_tpu.schedule.analysis import cross_slice_bytes
+
+    n = slice_size * n_slices
+    mesh_shape = (slice_size, n_slices)
+    count = 4 * n
+
+    for widths in candidate_topologies(n):
+        if widths == (1,):
+            continue
+        axes = _stage_axes(widths, mesh_shape)
+        if axes is None:
+            continue  # misaligned shapes are priced pessimistically
+        traffic = cross_slice_bytes(Topology(n, widths), count, 4, slice_size)
+        for i, ax in enumerate(axes):
+            crosses = sum(traffic["per_stage"][i]) > 0
+            assert crosses == (ax == 1), (
+                f"widths {widths} stage {i}: planner says axis {ax}, "
+                f"plans {'cross' if crosses else 'stay intra-slice'}"
+            )
