@@ -13,6 +13,7 @@ planner factors the device count along physical torus axes.
 from .schedule import (
     BlockLayout,
     Operation,
+    LonelyTopology,
     Topology,
     TopologyError,
     get_stages,
@@ -30,6 +31,7 @@ __all__ = [
     "BlockLayout",
     "Operation",
     "Topology",
+    "LonelyTopology",
     "TopologyError",
     "get_stages",
     "owned_blocks",

@@ -27,7 +27,7 @@ import numpy as np
 from ..ops.reduce import ReduceOp, get_op
 from ..schedule.blocks import BlockLayout
 from ..schedule.plan import owned_blocks, recv_plan, ring_plan, send_plan
-from ..schedule.stages import Topology
+from ..schedule.stages import LonelyTopology, Topology
 
 __all__ = ["simulate_allreduce", "simulate_tree_allreduce", "simulate_ring_allreduce"]
 
@@ -57,6 +57,15 @@ def simulate_allreduce(inputs, topo=None, op="sum") -> np.ndarray:
     rop.check_dtype(data.dtype)
     if n <= 1:  # trivial world, reference memcpy fast path (mpi_mod.hpp:1181-1188)
         return data.copy()
+    if isinstance(topo, LonelyTopology):
+        # the lonely protocol (stages.LonelyTopology): fold each lonely
+        # rank m+i into buddy i, tree over the first m rows, hand back
+        m = topo.tree.num_nodes
+        folded = data[:m].copy()
+        for i in range(topo.lonely):
+            folded[i] = rop.np_fn(folded[i], data[m + i])
+        out = simulate_tree_allreduce(folded, topo.tree, rop)
+        return np.tile(out[0], (n, 1))
     if topo.is_ring:
         return simulate_ring_allreduce(data, rop)
     return simulate_tree_allreduce(data, topo, rop)

@@ -4,7 +4,14 @@ Collectives (transport + algorithm layers, TPU-native), ring-attention
 sequence parallelism, and the dp/sp/tp sharded training step.
 """
 
-from .allreduce import allgather, allreduce, reduce_scatter, ring_allreduce, tree_allreduce
+from .allreduce import (
+    allgather,
+    allreduce,
+    lonely_allreduce,
+    reduce_scatter,
+    ring_allreduce,
+    tree_allreduce,
+)
 from .launch import (
     ClusterConfig,
     dcn_axis_names,
@@ -21,6 +28,7 @@ from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
 __all__ = [
     "allreduce",
     "tree_allreduce",
+    "lonely_allreduce",
     "ring_allreduce",
     "reduce_scatter",
     "allgather",

@@ -42,9 +42,16 @@ from jax import lax
 
 from ..ops.reduce import ReduceOp, get_op
 from ..schedule.blocks import BlockLayout
-from ..schedule.stages import Topology
+from ..schedule.stages import LonelyTopology, Topology, TopologyError
 
-__all__ = ["allreduce", "tree_allreduce", "ring_allreduce", "reduce_scatter", "allgather"]
+__all__ = [
+    "allreduce",
+    "tree_allreduce",
+    "lonely_allreduce",
+    "ring_allreduce",
+    "reduce_scatter",
+    "allgather",
+]
 
 # captured at import time so the interposer (``flextree_tpu.interpose``)
 # shadowing ``jax.lax.psum`` can never make our own tail reduction recurse
@@ -127,6 +134,8 @@ def allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
     if n <= 1:
         return x
     topo = Topology.resolve(n, topo)
+    if isinstance(topo, LonelyTopology):
+        return lonely_allreduce(x, axis_name, topo, op=rop)
     if topo.is_ring:
         return ring_allreduce(x, axis_name, op=rop)
     return tree_allreduce(x, axis_name, topo, op=rop)
@@ -148,6 +157,8 @@ def tree_allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
     rop = get_op(op)
     rop.check_dtype(x.dtype)
     topo = Topology.resolve(n, topo)
+    if isinstance(topo, LonelyTopology):
+        return lonely_allreduce(x, axis_name, topo, op=rop)
     shape = x.shape
     head, tail = _split_main_tail(x, n)
     parts = []
@@ -159,6 +170,65 @@ def tree_allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
         parts.append(_small_dense_allreduce(tail, axis_name, rop))
     v = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return v.reshape(shape)
+
+
+def lonely_allreduce(x: jax.Array, axis_name, topo, op="sum") -> jax.Array:
+    """Allreduce for ``"4,2+1"``-style shapes: a tree over the first ``m``
+    ranks plus ``l`` lonely ranks folded in through buddies.
+
+    The reference conceived exactly this (lonely nodes syncing alongside
+    the factorized tree, ``mpi_mod.hpp:77``) but shipped it disabled — its
+    runtime aborts on any ``FT_TOPO`` whose product != N
+    (``mpi_mod.hpp:914-918``), and its planner can only *advise* resizing
+    prime worlds (``ChooseWidth.h:16-21``).  TPU realization:
+
+    1. one ``ppermute`` moves each lonely rank's payload to its buddy
+       (rank ``i`` buddies lonely rank ``m + i``), which folds it;
+    2. the tree stages run restricted to ranks ``< m`` through the
+       ppermute-ring stage machinery — XLA's grouped collectives require
+       equal-size groups covering every rank, which a partial tree can't
+       satisfy, but a ``ppermute`` permutation can simply omit ranks
+       (they receive zeros; their results are overwritten in step 3);
+    3. one ``ppermute`` hands the buddies' full results back to the
+       lonely ranks.
+
+    The <m-element tail of non-divisible counts goes through one dense
+    collective over ALL ranks (lonely included), so it skips the fold.
+    """
+    n = lax.axis_size(axis_name)
+    rop = get_op(op)
+    rop.check_dtype(x.dtype)
+    topo = Topology.resolve(n, topo)
+    if not isinstance(topo, LonelyTopology):
+        return tree_allreduce(x, axis_name, topo, op=rop)
+    tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
+    fn = _jnp_fn(rop)
+    idx = lax.axis_index(axis_name)
+    shape = x.shape
+    v = x.reshape(-1)
+    head, tail = _split_main_tail(v, m)
+    parts = []
+    if head is not None:
+        with jax.named_scope("ft_lonely_fold"):
+            got = lax.ppermute(head, axis_name, [(m + i, i) for i in range(l)])
+            # only buddy ranks (idx < l) fold; everyone else keeps its data
+            # (got is zeros there, which is NOT the identity for min/band/..)
+            head = jnp.where(idx < l, fn(head, got), head)
+        for i, w in enumerate(tree.widths):
+            with jax.named_scope(f"ft_lonely_rs_stage{i}_w{w}"):
+                head = _grouped_reduce_scatter_generic(
+                    head, axis_name, tree, i, rop
+                )
+        for i in reversed(range(tree.num_stages)):
+            with jax.named_scope(f"ft_lonely_ag_stage{i}_w{tree.widths[i]}"):
+                head = _grouped_allgather_generic(head, axis_name, tree, i)
+        with jax.named_scope("ft_lonely_restore"):
+            got2 = lax.ppermute(head, axis_name, [(i, m + i) for i in range(l)])
+            parts.append(jnp.where(idx >= m, got2, head))
+    if tail is not None:
+        parts.append(_small_dense_allreduce(tail, axis_name, rop))
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return out.reshape(shape)
 
 
 def _tree_reduce_scatter(v, axis_name, topo: Topology, rop: ReduceOp):
@@ -195,7 +265,18 @@ def _tree_allgather(v, axis_name, topo: Topology):
     return v
 
 
-def _grouped_reduce_scatter_generic(v, axis_name, topo: Topology, stage: int, rop: ReduceOp):
+def _next_in_group(r: int, w: int, gap: int) -> int:
+    """Successor of rank ``r`` on its stage group's ring (group of ``r`` =
+    ``{base + j*gap}``, ``mpi_mod.hpp:162``) — shared by the RS and AG
+    ring helpers so their walks can't diverge."""
+    g0 = (r // (gap * w)) * (gap * w) + r % gap
+    p = (r // gap) % w
+    return g0 + ((p + 1) % w) * gap
+
+
+def _grouped_reduce_scatter_generic(
+    v, axis_name, topo: Topology, stage: int, rop: ReduceOp
+):
     """Width-w grouped reduce-scatter for non-sum ops: a true ring exchange.
 
     ``psum_scatter`` only sums, so band/bor/bxor/max/min/prod run the
@@ -211,20 +292,18 @@ def _grouped_reduce_scatter_generic(v, axis_name, topo: Topology, stage: int, ro
     plays the reference ring with label ``p-1``, so after ``w-1`` folds it
     owns fully-reduced block ``p`` — matching ``psum_scatter(tiled=True)``
     ownership so the sum and non-sum stage outputs are interchangeable.
+
+    The permutation covers ``topo.num_nodes`` ranks; when the topology is
+    a lonely tree over a PREFIX of the axis, ranks beyond it are simply
+    absent from the permutation (they receive zeros and compute garbage
+    that ``lonely_allreduce`` overwrites).
     """
-    n = topo.num_nodes
     w, gap = topo.widths[stage], topo.gaps[stage]
     fn = _jnp_fn(rop)
     tile = v.shape[0] // w
     idx = lax.axis_index(axis_name)
     pos = (idx // gap) % w
-
-    def next_in_group(r: int) -> int:
-        g0 = (r // (gap * w)) * (gap * w) + r % gap
-        p = (r // gap) % w
-        return g0 + ((p + 1) % w) * gap
-
-    perm = [(r, next_in_group(r)) for r in range(n)]
+    perm = [(r, _next_in_group(r, w, gap)) for r in range(topo.num_nodes)]
 
     def step(s, carry):
         acc, cur_send = carry
@@ -238,6 +317,35 @@ def _grouped_reduce_scatter_generic(v, axis_name, topo: Topology, stage: int, ro
 
     acc, _ = lax.fori_loop(0, w - 1, step, (v, (pos - 1) % w), unroll=False)
     return lax.dynamic_slice_in_dim(acc, pos * tile, tile, axis=0)
+
+
+def _grouped_allgather_generic(v, axis_name, topo: Topology, stage: int):
+    """Width-w grouped allgather as a ring broadcast (phase-2 counterpart
+    of ``_grouped_reduce_scatter_generic`` for restricted rank sets, where
+    ``lax.all_gather``'s equal-size-groups requirement can't hold).
+
+    On entry each group member at position ``p`` owns the fully-reduced
+    block ``p`` (the RS ownership convention); ``w-1`` forwarding steps
+    later every member holds all ``w`` blocks in group order — matching
+    ``lax.all_gather(tiled=True)`` layout.
+    """
+    w, gap = topo.widths[stage], topo.gaps[stage]
+    tile = v.shape[0]
+    idx = lax.axis_index(axis_name)
+    pos = (idx // gap) % w
+    perm = [(r, _next_in_group(r, w, gap)) for r in range(topo.num_nodes)]
+
+    out = jnp.zeros((tile * w,) + v.shape[1:], v.dtype)
+    out = lax.dynamic_update_slice_in_dim(out, v, pos * tile, axis=0)
+
+    def step(s, acc):
+        send_b = (pos - s) % w
+        chunk = lax.dynamic_slice_in_dim(acc, send_b * tile, tile, axis=0)
+        got = lax.ppermute(chunk, axis_name, perm)
+        recv_b = (pos - s - 1) % w
+        return lax.dynamic_update_slice_in_dim(acc, got, recv_b * tile, axis=0)
+
+    return lax.fori_loop(0, w - 1, step, out, unroll=False)
 
 
 # --------------------------------------------------------------------------
@@ -316,6 +424,13 @@ def reduce_scatter(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
     if n <= 1:
         return x.reshape(-1)
     topo = Topology.resolve(n, topo)
+    if isinstance(topo, LonelyTopology):
+        # lonely ranks own no block, so the phases aren't separable — the
+        # buddy fold/restore only makes sense around a full allreduce
+        raise TopologyError(
+            f"reduce_scatter does not support lonely topologies ({topo}); "
+            "use allreduce, or a product-of-widths shape"
+        )
     v, _ = _flatten_pad(x, n, rop)
     if topo.is_ring:
         flat = Topology.flat(n)
@@ -338,6 +453,11 @@ def allgather(x: jax.Array, axis_name, topo=None, out_shape=None) -> jax.Array:
         pass
     else:
         topo = Topology.resolve(n, topo)
+        if isinstance(topo, LonelyTopology):
+            raise TopologyError(
+                f"allgather does not support lonely topologies ({topo}); "
+                "use allreduce, or a product-of-widths shape"
+            )
         if topo.is_ring:
             topo = Topology.flat(n)
         x = _tree_allgather(x, axis_name, topo)

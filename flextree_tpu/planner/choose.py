@@ -8,10 +8,13 @@ or the ``FT_TOPO`` env var).
 
 Prime/odd device counts: the reference's planner proposes shapes for N±1
 (``ChooseWidth.h:16-21`` — the disabled "lonely node" idea), but its runtime
-aborts unless the width product equals N (``mpi_mod.hpp:914-918``).  We keep
-the same contract: for prime N the usable candidates are the flat tree and
-the ring, and the N±1 shapes are reported as *advisory* (what you'd get by
-resizing the job), matching the reference's printed ``+1``/``-1`` notation.
+aborts unless the width product equals N (``mpi_mod.hpp:914-918``).  Ours
+goes further: lonely shapes are EXECUTABLE (``"3,2+1"`` runs through
+``parallel.allreduce.lonely_allreduce``), so for prime N every
+factorization of N-1 plus one lonely rank joins the candidate table as a
+real choice, alongside the flat tree and the ring; the N±1 *resize*
+suggestions remain as advisory strings, matching the reference's printed
+``+1``/``-1`` notation.
 
 Torus-aware mode: given a mesh shape (e.g. ``(16, 16)``), only
 factorizations whose widths tile the torus axes in order are physical —
@@ -24,8 +27,13 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
-from ..schedule.stages import Topology
-from .cost_model import CostBreakdown, TpuCostParams, allreduce_cost
+from ..schedule.stages import LonelyTopology, Topology
+from .cost_model import (
+    CostBreakdown,
+    TpuCostParams,
+    allreduce_cost,
+    lonely_allreduce_cost,
+)
 from .factorize import is_prime, ordered_factorizations
 
 __all__ = ["Candidate", "Plan", "choose_topology", "candidate_topologies"]
@@ -36,6 +44,7 @@ class Candidate:
     widths: tuple[int, ...]
     cost: CostBreakdown
     torus_aligned: bool = False
+    lonely: int = 0  # ranks outside the tree (executable "+k" shapes)
 
     @property
     def total_us(self) -> float:
@@ -58,7 +67,10 @@ class Plan:
 
     def to_ft_topo(self) -> str:
         """The ``FT_TOPO`` env value selecting this plan."""
-        return ",".join(map(str, self.topology.widths))
+        spec = ",".join(map(str, self.topology.widths))
+        if isinstance(self.topology, LonelyTopology):
+            spec += f"+{self.topology.lonely}"
+        return spec
 
     def summary(self) -> str:
         lines = [
@@ -68,6 +80,8 @@ class Plan:
         for c in self.candidates[:8]:
             mark = " torus" if c.torus_aligned else ""
             shape = "ring" if c.widths == (1,) else "*".join(map(str, c.widths))
+            if c.lonely:
+                shape += f"+{c.lonely}"
             lines.append(
                 f"  {shape:>12}: {c.total_us:9.1f} µs "
                 f"(lat {c.cost.latency_us:.1f} + bw {c.cost.bandwidth_us:.1f} "
@@ -184,14 +198,27 @@ def choose_topology(
         cost = allreduce_cost(topo, nbytes, params, dcn_stages=dcn_stages)
         cands.append(Candidate(widths, cost, aligned))
 
-    # prefer torus-aligned shapes at equal cost; then cheapest
-    cands.sort(key=lambda c: (c.total_us, not c.torus_aligned, len(c.widths)))
-    best = cands[0]
-    topo = Topology.ring(n) if best.widths == (1,) else Topology(n, best.widths)
-
     advisory: tuple[str, ...] = ()
     if is_prime(n) and n > 3:
-        # the reference's ChooseWidth N±1 suggestion (ChooseWidth.h:16-21)
+        # Prime N: the reference could only *advise* resizing to N±1
+        # (ChooseWidth.h:16-21; its runtime aborts on product != N).  Our
+        # runtime executes lonely shapes (schedule.stages.LonelyTopology),
+        # so every factorization of N-1 plus one lonely rank enters the
+        # candidate table for real.  Lonely candidates are priced
+        # fabric-uniform (a +1 world doesn't tile a torus; the tree part's
+        # stages still ride ICI, the buddy hop is rank-adjacent).
+        for widths in ordered_factorizations(n - 1):
+            tree = Topology(n - 1, widths)
+            # like misaligned shapes: when a DCN boundary exists, a +1
+            # world can't tile the torus, so price every tree stage at DCN
+            # (pessimistic) rather than let an optimistic ICI-only estimate
+            # win
+            dcn_lonely = tuple(range(len(widths))) if dcn_axes else ()
+            cost = lonely_allreduce_cost(
+                tree, 1, nbytes, params, dcn_stages=dcn_lonely,
+                buddy_crosses_dcn=bool(dcn_axes),
+            )
+            cands.append(Candidate(widths, cost, False, lonely=1))
         near = []
         from .shapes import format_shape
 
@@ -202,5 +229,18 @@ def choose_topology(
                 f"topo {format_shape(alt.widths, delta)}"
             )
         advisory = tuple(near)
+
+    # prefer torus-aligned shapes at equal cost, then in-tree over lonely,
+    # then fewer stages
+    cands.sort(
+        key=lambda c: (c.total_us, not c.torus_aligned, c.lonely, len(c.widths))
+    )
+    best = cands[0]
+    if best.lonely:
+        topo = LonelyTopology(n, Topology(n - best.lonely, best.widths), best.lonely)
+    elif best.widths == (1,):
+        topo = Topology.ring(n)
+    else:
+        topo = Topology(n, best.widths)
 
     return Plan(n, nbytes, topo, tuple(cands), advisory)

@@ -31,7 +31,14 @@ from dataclasses import dataclass
 
 from ..schedule.stages import Topology
 
-__all__ = ["LinkParams", "TpuCostParams", "CostBreakdown", "allreduce_cost", "ring_cost"]
+__all__ = [
+    "LinkParams",
+    "TpuCostParams",
+    "CostBreakdown",
+    "allreduce_cost",
+    "lonely_allreduce_cost",
+    "ring_cost",
+]
 
 
 @dataclass(frozen=True)
@@ -115,6 +122,40 @@ def allreduce_cost(
         red += stage_bytes / (params.reduce_bw_GBps * 1e3)  # phase 1 only
         ctl += 2 * params.control_us_per_width * max(0, w - 2)
     return CostBreakdown(lat, bw, red, ctl)
+
+
+def lonely_allreduce_cost(
+    tree_topo: Topology,
+    lonely: int,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    dcn_stages: tuple[int, ...] = (),
+    buddy_crosses_dcn: bool = False,
+) -> CostBreakdown:
+    """Cost of a ``tree+lonely`` shape (``schedule.stages.LonelyTopology``).
+
+    The tree allreduce over ``m = tree_topo.num_nodes`` ranks plus two
+    buddy ``ppermute`` exchanges moving the FULL payload (lonely -> buddy
+    fold, buddy -> lonely restore) and one extra fold at the buddy.  Buddy
+    pairs span ``m`` ranks (lonely rank ``m+i`` pairs with rank ``i``), so
+    on a multi-slice system the hop can cross the DCN boundary — pass
+    ``buddy_crosses_dcn=True`` to price the two full-payload exchanges at
+    DCN constants (the chooser does whenever ``dcn_axes`` is set; billing
+    the dominant 2·S term at ICI would let lonely shapes win on an
+    underestimate).  Implementation note: the runtime's lonely tree stages
+    ride the ppermute-ring machinery rather than fused grouped collectives
+    (``parallel/allreduce.py::lonely_allreduce``), which this model does
+    not surcharge — the per-stage traffic is identical and the launch term
+    already counts per stage.
+    """
+    base = allreduce_cost(tree_topo, nbytes, params, dcn_stages=dcn_stages)
+    if lonely <= 0:
+        return base
+    link = params.dcn if buddy_crosses_dcn else params.ici
+    lat = base.latency_us + 2 * (link.latency_us + params.launch_us)
+    bw = base.bandwidth_us + 2 * link.time_us(nbytes)
+    red = base.reduce_us + nbytes / (params.reduce_bw_GBps * 1e3)
+    return CostBreakdown(lat, bw, red, base.control_us)
 
 
 def ring_cost(

@@ -4,7 +4,15 @@ The TPU-native analog of the reference's L2 layer (``mpi_mod.hpp:45-214,
 882-929``), kept transport-free by design.
 """
 
-from .stages import Topology, TopologyError, parse_topo, get_stages, FT_TOPO_ENV
+from .stages import (
+    FT_TOPO_ENV,
+    LonelyTopology,
+    Topology,
+    TopologyError,
+    get_stages,
+    parse_topo,
+    split_lonely_spec,
+)
 from .blocks import BlockLayout
 from .plan import (
     Operation,
@@ -24,7 +32,9 @@ __all__ = [
     "validate_topology",
     "validate_ring",
     "Topology",
+    "LonelyTopology",
     "TopologyError",
+    "split_lonely_spec",
     "parse_topo",
     "get_stages",
     "FT_TOPO_ENV",

@@ -24,7 +24,15 @@ import math
 import os
 from dataclasses import dataclass, field
 
-__all__ = ["Topology", "TopologyError", "parse_topo", "get_stages", "FT_TOPO_ENV"]
+__all__ = [
+    "Topology",
+    "LonelyTopology",
+    "TopologyError",
+    "parse_topo",
+    "split_lonely_spec",
+    "get_stages",
+    "FT_TOPO_ENV",
+]
 
 FT_TOPO_ENV = "FT_TOPO"
 
@@ -52,6 +60,28 @@ def parse_topo(spec: str) -> tuple[int, ...]:
         except ValueError as e:
             raise TopologyError(f"bad width token {tok!r} in topo spec {spec!r}") from e
     return tuple(out)
+
+
+def split_lonely_spec(spec: str) -> tuple[str, int]:
+    """Split a ``"4,2+1"``-style spec into (``"4,2"``, 1).
+
+    The ``+k`` suffix is the reference planner's own notation for shapes
+    with ``k`` nodes outside the factorized tree
+    (``cost_model/PrintTreeStructure.h``: ``2*3+1``); the reference runtime
+    never executed them (its lonely-node code is commented out,
+    ``mpi_mod.hpp:983-1086``) — ours does (``LonelyTopology``).
+    """
+    spec = spec.strip()
+    if "+" not in spec:
+        return spec, 0
+    base, _, tail = spec.rpartition("+")
+    try:
+        lonely = int(tail.strip())
+    except ValueError as e:
+        raise TopologyError(f"bad lonely count {tail!r} in spec {spec!r}") from e
+    if lonely < 0:
+        raise TopologyError(f"lonely count must be >= 0, got {lonely}")
+    return base.strip(), lonely
 
 
 def get_stages(num_nodes: int, spec: str | None = None) -> tuple[int, ...]:
@@ -158,10 +188,18 @@ class Topology:
         return cls(num_nodes, get_stages(num_nodes, spec))
 
     @classmethod
-    def resolve(cls, num_nodes: int, topo=None) -> "Topology":
-        """Coerce ``topo`` (None | Topology | width sequence | spec string)."""
+    def resolve(cls, num_nodes: int, topo=None):
+        """Coerce ``topo`` (None | Topology | LonelyTopology | width
+        sequence | spec string) — specs with a ``+k`` suffix (``"4,2+1"``)
+        resolve to a ``LonelyTopology``."""
         if topo is None:
-            return cls.from_env(num_nodes)
+            topo = os.environ.get(FT_TOPO_ENV, "")
+        if isinstance(topo, LonelyTopology):
+            if topo.num_nodes != num_nodes:
+                raise TopologyError(
+                    f"topology is for {topo.num_nodes} nodes, mesh has {num_nodes}"
+                )
+            return topo
         if isinstance(topo, Topology):
             if topo.num_nodes != num_nodes:
                 raise TopologyError(
@@ -169,7 +207,13 @@ class Topology:
                 )
             return topo
         if isinstance(topo, str):
-            return cls(num_nodes, get_stages(num_nodes, topo))
+            base, lonely = split_lonely_spec(topo)
+            if lonely:
+                tree = cls(
+                    num_nodes - lonely, get_stages(num_nodes - lonely, base)
+                )
+                return LonelyTopology(num_nodes, tree, lonely)
+            return cls(num_nodes, get_stages(num_nodes, base))
         widths = tuple(int(w) for w in topo)
         if any(w == 1 for w in widths):
             return cls.ring(num_nodes)
@@ -218,3 +262,68 @@ class Topology:
 
     def __str__(self) -> str:
         return "*".join(str(w) for w in self.widths)
+
+
+@dataclass(frozen=True)
+class LonelyTopology:
+    """A tree over ``num_nodes - lonely`` ranks plus ``lonely`` ranks
+    outside it — the reference's conceived-but-disabled lonely-node design
+    (``mpi_mod.hpp:77``: nodes beyond the factorized tree "sync in parallel
+    with the tree"; all its call sites are commented out, SURVEY §2.1)
+    made executable, TPU-style:
+
+    - each lonely rank ``m + i`` pairs with buddy rank ``i`` in the tree;
+    - pre-phase: one ``ppermute`` moves every lonely payload to its buddy,
+      which folds it in (so the tree reduces all ``num_nodes``
+      contributions);
+    - the tree allreduce runs over the first ``m`` ranks (via the
+      ppermute-ring stage machinery — XLA's grouped collectives demand
+      equal-size groups, which lonely ranks would break);
+    - post-phase: one ``ppermute`` hands each buddy's full result back.
+
+    This is what turns the planner's prime-N "resize to N±1" *advisory*
+    (``ChooseWidth.h:16-21``) into a runnable shape: N=7 can execute
+    ``"3,2+1"`` instead of being told to use 6 chips.
+    """
+
+    num_nodes: int
+    tree: Topology
+    lonely: int
+
+    def __post_init__(self):
+        if self.lonely < 1:
+            raise TopologyError(
+                f"lonely must be >= 1, got {self.lonely} (use Topology)"
+            )
+        if self.tree.is_ring:
+            raise TopologyError("lonely ranks require a tree, not the ring")
+        if self.tree.num_nodes + self.lonely != self.num_nodes:
+            raise TopologyError(
+                f"tree over {self.tree.num_nodes} + {self.lonely} lonely "
+                f"!= {self.num_nodes} nodes"
+            )
+        if self.lonely > self.tree.num_nodes:
+            raise TopologyError(
+                f"{self.lonely} lonely ranks need {self.lonely} distinct "
+                f"buddies but the tree has only {self.tree.num_nodes}"
+            )
+
+    @property
+    def is_ring(self) -> bool:
+        return False
+
+    @property
+    def widths(self) -> tuple[int, ...]:
+        return self.tree.widths
+
+    @property
+    def num_stages(self) -> int:
+        return self.tree.num_stages
+
+    @property
+    def message_steps(self) -> int:
+        """Tree rounds plus the two buddy exchanges."""
+        return self.tree.message_steps + 2
+
+    def __str__(self) -> str:
+        return f"{self.tree}+{self.lonely}"

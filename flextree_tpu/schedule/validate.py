@@ -51,8 +51,22 @@ class ValidationStats:
     p2p_messages: int  # total cross-rank (peer != self) ops, both phases
 
 
-def validate(topo: Topology) -> ValidationStats:
-    """Validate any topology (ring sentinel or k-ary tree)."""
+def validate(topo) -> ValidationStats:
+    """Validate any topology (ring sentinel, k-ary tree, or tree+lonely)."""
+    from .stages import LonelyTopology
+
+    if isinstance(topo, LonelyTopology):
+        # the tree part carries all schedule structure; the lonely protocol
+        # adds one fold ppermute and one restore ppermute per lonely rank,
+        # each a distinct (buddy, lonely) pair — structurally race-free by
+        # construction (validated here as message accounting)
+        tree_stats = validate_topology(topo.tree)
+        return ValidationStats(
+            num_nodes=topo.num_nodes,
+            widths=tree_stats.widths,
+            stages=tree_stats.stages,
+            p2p_messages=tree_stats.p2p_messages + 2 * topo.lonely,
+        )
     if topo.is_ring:
         return validate_ring(topo.num_nodes)
     return validate_topology(topo)

@@ -1,0 +1,203 @@
+"""Executable lonely-node topologies ("4,2+1" shapes).
+
+The reference conceived lonely nodes (ranks outside the factorized tree,
+``mpi_mod.hpp:77``) but shipped the machinery disabled — every call site
+commented out, the runtime aborting on product != N
+(``mpi_mod.hpp:914-918``) — leaving its planner able only to *advise*
+resizing prime worlds (``ChooseWidth.h:16-21``).  These tests pin our
+executable realization at all three levels: spec parsing, the NumPy
+simulator, and the JAX collective on the 8-vdev mesh vs the psum oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flextree_tpu.backends import simulate_allreduce
+from flextree_tpu.parallel.mesh import allreduce_over_mesh, flat_mesh
+from flextree_tpu.schedule.stages import (
+    LonelyTopology,
+    Topology,
+    TopologyError,
+    split_lonely_spec,
+)
+
+
+class TestSpec:
+    def test_split(self):
+        assert split_lonely_spec("4,2+1") == ("4,2", 1)
+        assert split_lonely_spec("7+1") == ("7", 1)
+        assert split_lonely_spec("3,2 + 2") == ("3,2", 2)
+        assert split_lonely_spec("4,2") == ("4,2", 0)
+
+    def test_resolve_roundtrip(self):
+        t = Topology.resolve(7, "3,2+1")
+        assert isinstance(t, LonelyTopology)
+        assert t.tree.widths == (3, 2) and t.lonely == 1
+        assert str(t) == "3*2+1"
+        assert t.message_steps == t.tree.message_steps + 2
+        # env-style via resolve(None) path
+        t8 = Topology.resolve(8, "7+1")
+        assert t8.tree.widths == (7,) and t8.lonely == 1
+
+    def test_errors(self):
+        with pytest.raises(TopologyError):
+            Topology.resolve(7, "3,2+2")  # 6 + 2 != 7
+        with pytest.raises(TopologyError):
+            Topology.resolve(5, "2+3")  # more lonely than buddies
+        with pytest.raises(TopologyError):
+            Topology.resolve(7, "1+1")  # ring + lonely unsupported
+        with pytest.raises(TopologyError):
+            Topology.resolve(7, "3,2+x")
+
+
+class TestSimulator:
+    @pytest.mark.parametrize(
+        "n,spec",
+        [(7, "3,2+1"), (7, "6+1"), (8, "7+1"), (8, "3,2+2"), (5, "2,2+1")],
+    )
+    @pytest.mark.parametrize("count", [35, 42, 6])
+    def test_matches_numpy_sum(self, n, spec, count):
+        rng = np.random.default_rng(n * count)
+        data = rng.standard_normal((n, count))
+        out = simulate_allreduce(data, spec)
+        np.testing.assert_allclose(
+            out, np.tile(data.sum(0), (n, 1)), rtol=1e-9, atol=1e-9
+        )
+
+    def test_matches_numpy_max(self):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((7, 33))
+        out = simulate_allreduce(data, "3,2+1", op="max")
+        np.testing.assert_array_equal(out, np.tile(data.max(0), (7, 1)))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestJaxCollective:
+    def _run(self, n, spec, count, op="sum", dtype=jnp.float32):
+        mesh = flat_mesh(n, "ft")
+        rng = np.random.default_rng(count * n)
+        data = jnp.asarray(
+            rng.integers(-8, 8, (n, count)).astype(np.float64), dtype
+        )
+        out = allreduce_over_mesh(data, mesh, topo=spec, op=op)
+        return np.asarray(jax.device_get(out)), np.asarray(
+            jax.device_get(data)
+        )
+
+    @pytest.mark.parametrize(
+        "n,spec", [(7, "3,2+1"), (8, "7+1"), (8, "3,2+2"), (5, "2,2+1")]
+    )
+    @pytest.mark.parametrize("count", [64, 37])  # divisible + ragged tail
+    def test_matches_psum_semantics(self, n, spec, count):
+        got, data = self._run(n, spec, count)
+        want = np.tile(data.sum(0), (n, 1))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_non_sum_op(self):
+        got, data = self._run(7, "3,2+1", 48, op="min")
+        np.testing.assert_array_equal(got, np.tile(data.min(0), (7, 1)))
+
+    def test_int_dtype(self):
+        got, data = self._run(8, "7+1", 40, dtype=jnp.int32)
+        np.testing.assert_array_equal(
+            got, np.tile(data.sum(0).astype(np.int32), (8, 1))
+        )
+
+    def test_ft_topo_env(self, monkeypatch):
+        monkeypatch.setenv("FT_TOPO", "3,2+1")
+        mesh = flat_mesh(7, "ft")
+        data = jnp.asarray(np.arange(7 * 12, dtype=np.float32).reshape(7, 12))
+        out = np.asarray(
+            jax.device_get(allreduce_over_mesh(data, mesh, topo=None))
+        )
+        want = np.tile(np.asarray(data).sum(0), (7, 1))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+class TestPlanner:
+    def test_prime_n_has_executable_lonely_candidates(self):
+        from flextree_tpu.planner import choose_topology
+
+        plan = choose_topology(7, 1 << 20)
+        lonely = [c for c in plan.candidates if c.lonely]
+        # every factorization of 6 appears as an executable +1 shape
+        assert {c.widths for c in lonely} == {(6,), (2, 3), (3, 2)}
+        assert all(c.lonely == 1 for c in lonely)
+        # uniform fabric: lonely moves the full payload twice extra, so the
+        # flat in-tree shape must still win
+        assert plan.widths == (7,)
+
+    def test_lonely_plan_roundtrips_to_runtime(self):
+        """A plan whose argmin is a lonely shape must produce an FT_TOPO
+        spec the runtime resolves and executes."""
+        from flextree_tpu.planner import choose_topology
+        from flextree_tpu.planner.choose import Candidate, Plan
+
+        plan = choose_topology(7, 1 << 20)
+        lonely = next(c for c in plan.candidates if c.lonely)
+        # build the spec the summary/ft_topo path would emit for it
+        t = LonelyTopology(7, Topology(6, lonely.widths), 1)
+        spec = f"{','.join(map(str, lonely.widths))}+1"
+        resolved = Topology.resolve(7, spec)
+        assert resolved == t
+        out = simulate_allreduce(np.ones((7, 12)), spec)
+        np.testing.assert_allclose(out, np.full((7, 12), 7.0))
+
+    def test_lonely_cost_adds_buddy_terms(self):
+        from flextree_tpu.planner import TpuCostParams, allreduce_cost
+        from flextree_tpu.planner.cost_model import lonely_allreduce_cost
+
+        p = TpuCostParams()
+        tree = Topology(6, (3, 2))
+        base = allreduce_cost(tree, 1 << 20, p)
+        lone = lonely_allreduce_cost(tree, 1, 1 << 20, p)
+        assert lone.latency_us == base.latency_us + 2 * (p.ici.latency_us + p.launch_us)
+        assert lone.bandwidth_us > base.bandwidth_us
+        assert lone.reduce_us > base.reduce_us
+
+    def test_summary_prints_lonely_notation(self):
+        from flextree_tpu.planner import choose_topology
+
+        s = choose_topology(7, 1 << 20).summary()
+        assert "+1" in s  # the reference's PrintTreeStructure notation
+
+
+def test_validator_accepts_lonely():
+    from flextree_tpu.schedule.validate import validate
+
+    t = Topology.resolve(7, "3,2+1")
+    stats = validate(t)
+    assert stats.num_nodes == 7
+    tree_stats = validate(t.tree)
+    assert stats.p2p_messages == tree_stats.p2p_messages + 2
+
+
+def test_phase_apis_reject_lonely_clearly():
+    from flextree_tpu.parallel import allgather, reduce_scatter
+    from flextree_tpu.parallel.mesh import flat_mesh
+    from jax.sharding import PartitionSpec as P
+
+    mesh = flat_mesh(7, "ft")
+
+    def body(row):
+        return reduce_scatter(row[0], "ft", topo="3,2+1")[None]
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("ft"), out_specs=P("ft")))
+    with pytest.raises(TopologyError, match="lonely"):
+        f(jnp.ones((7, 14)))
+
+
+def test_lonely_cost_dcn_buddy_pricing():
+    from flextree_tpu.planner import TpuCostParams
+    from flextree_tpu.planner.cost_model import lonely_allreduce_cost
+
+    p = TpuCostParams()
+    tree = Topology(6, (3, 2))
+    ici = lonely_allreduce_cost(tree, 1, 1 << 24, p)
+    dcn = lonely_allreduce_cost(tree, 1, 1 << 24, p, buddy_crosses_dcn=True)
+    # DCN buddy pricing must be strictly costlier (6 vs 45 GB/s links)
+    assert dcn.bandwidth_us > ici.bandwidth_us
+    assert dcn.latency_us > ici.latency_us
