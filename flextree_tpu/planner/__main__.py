@@ -16,7 +16,7 @@ import time
 from .choose import choose_topology
 from .cost_model import TpuCostParams, LinkParams
 from .factorize import count_ordered_factorizations
-from .native import native_available, native_choose
+from .native import native_available, native_choose_lonely
 
 
 def main(argv=None) -> int:
@@ -92,12 +92,17 @@ def main(argv=None) -> int:
         print("n,num_shapes,chosen,plan_us")
         for n in range(2, args.sweep + 1):
             t0 = time.perf_counter()
+            lonely = 0
             if use_native:
-                widths, _ = native_choose(n, nbytes, params)
+                widths, lonely, _ = native_choose_lonely(n, nbytes, params)
             else:
-                widths = choose_topology(n, nbytes, params).widths
+                plan = choose_topology(n, nbytes, params)
+                widths = plan.widths
+                lonely = getattr(plan.topology, "lonely", 0)
             dt = (time.perf_counter() - t0) * 1e6
             shape = "ring" if widths == (1,) else "*".join(map(str, widths))
+            if lonely:
+                shape += f"+{lonely}"
             print(f"{n},{count_ordered_factorizations(n)},{shape},{dt:.1f}")
         return 0
 
@@ -116,12 +121,14 @@ def main(argv=None) -> int:
     print(plan.summary())
     print(f"FT_TOPO={plan.to_ft_topo()}")
     if args.native:
-        nat = native_choose(args.n, nbytes, params)
+        nat = native_choose_lonely(args.n, nbytes, params)
         if nat is None:
             print("native core unavailable (build failed?)", file=sys.stderr)
         else:
-            widths, cost = nat
+            widths, lonely, cost = nat
             shape = "ring" if widths == (1,) else "*".join(map(str, widths))
+            if lonely:
+                shape += f"+{lonely}"
             print(f"native argmin: {shape} ({cost:.1f} µs)")
     return 0
 

@@ -16,7 +16,13 @@ from pathlib import Path
 
 from .cost_model import TpuCostParams
 
-__all__ = ["load_native", "native_available", "native_choose", "native_count_shapes"]
+__all__ = [
+    "load_native",
+    "native_available",
+    "native_choose",
+    "native_choose_lonely",
+    "native_count_shapes",
+]
 
 _NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
 _LIB_NAME = "libflextree_planner.so"
@@ -48,8 +54,10 @@ def load_native(build_if_missing: bool = True):
         lib = ctypes.CDLL(str(lib_path))
     except OSError:
         return None
-    if not hasattr(lib, "ft_validate"):
-        # stale library built from an older source tree (pre schedule-core).
+    if not hasattr(lib, "ft_choose2"):
+        # stale library built from an older source tree (the marker symbol
+        # is the NEWEST entry point — bump it whenever the ABI grows, or a
+        # prebuilt .so silently lacks the new path).
         # Rebuild, then load through a fresh temp copy: dlopen caches by
         # path, so re-CDLL'ing the same file would return the old mapping.
         if not (build_if_missing and _run_make(force=True)):
@@ -66,7 +74,7 @@ def load_native(build_if_missing: bool = True):
             lib = ctypes.CDLL(tmp.name)
         except OSError:
             return None
-        if not hasattr(lib, "ft_validate"):
+        if not hasattr(lib, "ft_choose2"):
             return None
 
     lib.ft_count_shapes.restype = ctypes.c_uint64
@@ -94,6 +102,15 @@ def load_native(build_if_missing: bool = True):
     ]
     lib.ft_sweep.restype = ctypes.c_uint64
     lib.ft_sweep.argtypes = [ctypes.c_uint64] + [ctypes.c_double] * 6
+    lib.ft_choose2.restype = ctypes.c_int32
+    lib.ft_choose2.argtypes = [
+        ctypes.c_uint64,
+    ] + [ctypes.c_double] * 6 + [
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
     return lib
 
 
@@ -151,7 +168,13 @@ def native_shape_cost(
 def native_choose(
     n: int, nbytes: float, params: TpuCostParams = TpuCostParams()
 ) -> tuple[tuple[int, ...], float] | None:
-    """Native argmin over candidate shapes; (widths, predicted µs) or None."""
+    """Native IN-TREE argmin; (widths, predicted µs) or None.
+
+    Never returns lonely shapes: the historical contract is that the
+    returned widths are directly usable as an ``n``-rank topology
+    (product == n, or the ring sentinel).  Use ``native_choose_lonely``
+    for the full candidate space including executable ``+1`` shapes.
+    """
     lib = load_native()
     if lib is None:
         return None
@@ -163,6 +186,27 @@ def native_choose(
     if k < 0:
         return None
     return tuple(out[:k]), float(cost.value)
+
+
+def native_choose_lonely(
+    n: int, nbytes: float, params: TpuCostParams = TpuCostParams()
+) -> tuple[tuple[int, ...], int, float] | None:
+    """(widths, lonely, predicted µs) — lonely is 0 for in-tree winners,
+    1 when a tree-over-(n-1)-plus-one-lonely shape wins (prime n); a
+    lonely winner's widths are the TREE widths (spec = "w0,..,wk+1")."""
+    lib = load_native()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint32 * 64)()
+    cost = ctypes.c_double(0.0)
+    lonely = ctypes.c_uint32(0)
+    k = lib.ft_choose2(
+        n, float(nbytes), *_param_args(params), out, 64,
+        ctypes.byref(cost), ctypes.byref(lonely),
+    )
+    if k < 0:
+        return None
+    return tuple(out[:k]), int(lonely.value), float(cost.value)
 
 
 def native_sweep(

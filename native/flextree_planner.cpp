@@ -183,6 +183,51 @@ int32_t ft_choose(uint64_t n, double nbytes, double ici_bw, double ici_lat,
   return static_cast<int32_t>(best_shape.size());
 }
 
+// Argmin including EXECUTABLE lonely shapes for prime n (the "+k"
+// topologies of schedule/stages.py::LonelyTopology — tree over n-1 ranks
+// plus one lonely rank folded through a buddy; the reference's disabled
+// design, mpi_mod.hpp:77).  Mirrors choose_topology's candidate set on a
+// uniform fabric.  *lonely_out receives 0 for in-tree winners, 1 when a
+// +1 shape wins (its tree widths are what's written to `out`).
+// Kept as a separate symbol so the ft_choose ABI stays stable for older
+// callers.
+int32_t ft_choose2(uint64_t n, double nbytes, double ici_bw, double ici_lat,
+                   double reduce_bw, double ctl_per_width, double launch_us,
+                   uint32_t* out, uint32_t out_cap, double* best_cost,
+                   uint32_t* lonely_out) {
+  int32_t k = ft_choose(n, nbytes, ici_bw, ici_lat, reduce_bw, ctl_per_width,
+                        launch_us, out, out_cap, best_cost);
+  if (k < 0 || lonely_out == nullptr) return k;
+  *lonely_out = 0;
+  // prime test (n >= 4 composite counts already enumerate shapes)
+  bool prime = n > 3;
+  for (uint64_t d = 2; prime && d * d <= n; ++d)
+    if (n % d == 0) prime = false;
+  if (!prime || n <= 3) return k;
+  CostParams p{ici_bw, ici_lat, reduce_bw, ctl_per_width, launch_us};
+  const double extra = 2.0 * (p.ici_latency_us + p.launch_us) +
+                       2.0 * nbytes / (p.ici_bw_GBps * 1e3) +
+                       nbytes / (p.reduce_bw_GBps * 1e3);
+  double best = *best_cost;
+  std::vector<uint32_t> best_shape;
+  for (const auto& s : enumerate_shapes(n - 1)) {
+    double c = tree_cost(s.data(), static_cast<uint32_t>(s.size()), p, nbytes)
+               + extra;
+    // in-tree shapes win ties (the Python chooser's `c.lonely` sort key)
+    if (c < best || (c == best && !best_shape.empty() &&
+                     s.size() < best_shape.size())) {
+      best = c;
+      best_shape = s;
+    }
+  }
+  if (best_shape.empty()) return k;  // no lonely winner
+  if (best_shape.size() > out_cap) return -1;
+  std::memcpy(out, best_shape.data(), best_shape.size() * sizeof(uint32_t));
+  if (best_cost) *best_cost = best;
+  *lonely_out = 1;
+  return static_cast<int32_t>(best_shape.size());
+}
+
 // Planner throughput sweep (the reference's main.cpp N=1..999 loop):
 // for n in [1, n_max], count shapes and run the argmin; returns total
 // shapes visited.  Used to benchmark the native core.

@@ -201,3 +201,49 @@ def test_lonely_cost_dcn_buddy_pricing():
     # DCN buddy pricing must be strictly costlier (6 vs 45 GB/s links)
     assert dcn.bandwidth_us > ici.bandwidth_us
     assert dcn.latency_us > ici.latency_us
+
+
+def test_lonely_shape_can_win_and_native_twin_agrees():
+    """A parameter regime where a +1 shape is the argmin — the ring pays
+    2(n-1) launches, flat pays width control, and the two-stage lonely
+    tree threads between them — and the native C++ twin (ft_choose2)
+    agrees on winner, lonely flag, and cost."""
+    from flextree_tpu.planner import LinkParams, TpuCostParams, choose_topology
+    from flextree_tpu.planner.native import native_available, native_choose_lonely
+
+    p = TpuCostParams(
+        ici=LinkParams(1e9, 0.0), dcn=LinkParams(1e9, 0.0),
+        reduce_bw_GBps=1e9, control_us_per_width=100.0, launch_us=100.0,
+    )
+    plan = choose_topology(7, 1 << 10, params=p)
+    assert isinstance(plan.topology, LonelyTopology), plan.summary()
+    assert plan.to_ft_topo().endswith("+1")
+    # the winning spec must execute
+    out = simulate_allreduce(np.ones((7, 14)), plan.to_ft_topo())
+    np.testing.assert_allclose(out, np.full((7, 14), 7.0))
+    if native_available():
+        widths, lonely, cost = native_choose_lonely(7, 1 << 10, p)
+        assert (widths, lonely) == (plan.widths, 1)
+        assert abs(cost - plan.candidates[0].total_us) < 1e-3
+
+
+@pytest.mark.parametrize("n", [7, 8, 12, 13, 30])
+def test_native_choose_matches_python_incl_lonely(n):
+    """Twin parity on cost and lonely flag.  Costs, not widths: the argmin
+    has exact ties at n=8/12/30 ((2,4)/(4,2) etc.), so shape equality
+    would only hold by enumeration-order coincidence — same reasoning as
+    tests/test_planner.py's existing cost-parity check."""
+    from flextree_tpu.planner import TpuCostParams, choose_topology
+    from flextree_tpu.planner.native import native_available, native_choose_lonely
+
+    if not native_available():
+        pytest.skip("native library not built")
+    widths, lonely, cost = native_choose_lonely(n, 1 << 20, TpuCostParams())
+    py = choose_topology(n, 1 << 20, params=TpuCostParams())
+    py_lonely = 1 if isinstance(py.topology, LonelyTopology) else 0
+    assert lonely == py_lonely
+    assert cost == pytest.approx(py.candidates[0].total_us, rel=1e-9)
+    # the returned widths must be a VALID shape for this world size
+    import math
+
+    assert math.prod(widths) + lonely == n or widths == (1,)

@@ -57,6 +57,13 @@ def config_matrix(quick: bool) -> list[dict]:
         dict(name="cfg5_np2_60r_4MB", ranks=60, size_mb=4, repeat=5,
              primary="planner", topos=["planner", "60", "4,15", "5,12", "3,4,5"],
              baseline_ref="BASELINE.md config 5: non-power-of-2 world size (60 ranks)"),
+        dict(name="cfg6_prime_7r_4MB", ranks=7, size_mb=4, repeat=10,
+             primary="planner", topos=["planner", "7", "1", "6+1", "3,2+1"],
+             baseline_ref="prime world size: flat/ring vs EXECUTABLE lonely "
+                          "shapes (the reference's disabled +1 design; "
+                          "tests/test_lonely.py) — expected ordering on a "
+                          "uniform 1-core fabric: flat > lonely (2 extra "
+                          "full-payload hops), per the cost model"),
         # size sweeps: where is the crossover vs psum?
         dict(name="sweep_8r", ranks=8, size_mb=[1, 4, 16, 64], repeat=5,
              primary="8", topos=["8", "4,2", "2,2,2"],
