@@ -11,7 +11,12 @@ Special cases (mirroring the reference semantics of
 - ``[2, 2, ..., 2]``          -> recursive halving-doubling
 - any width ``1`` anywhere    -> collapse to ``[1]`` = use the ring algorithm
 - product != N                -> hard error (the reference aborts;
-                                 ``mpi_mod.hpp:914-918``)
+                                 ``mpi_mod.hpp:914-918``) — UNLESS the spec
+                                 carries a ``+k`` suffix, which resolves to
+                                 a ``LonelyTopology`` (tree over N-k ranks
+                                 plus k buddy-folded lonely ranks; the
+                                 reference's disabled design, executable
+                                 here)
 
 The environment variable ``FT_TOPO`` (comma-separated widths, e.g. ``"4,2"``)
 is honoured for drop-in compatibility with the reference
