@@ -247,3 +247,37 @@ def test_native_choose_matches_python_incl_lonely(n):
     import math
 
     assert math.prod(widths) + lonely == n or widths == (1,)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_lonely_grad_sync_through_train_step():
+    """FT_TOPO=7+1 gradient sync through the production train step matches
+    the native-psum sync exactly (the dryrun's part-4 check, pinned in the
+    suite)."""
+    from flextree_tpu.models.transformer import TransformerConfig
+    from flextree_tpu.parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_3d,
+        make_train_step,
+    )
+
+    mesh = make_mesh_3d(8, (8, 1, 1))
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        dtype=jnp.float32,
+    )
+    state = init_train_state(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 64, (16, 8)), jnp.int32)
+    tgts = jnp.asarray(rng.integers(0, 64, (16, 8)), jnp.int32)
+    lone_step = make_train_step(mesh, cfg, TrainConfig(lr=1e-3, grad_topo="7+1"))
+    psum_step = make_train_step(mesh, cfg, TrainConfig(lr=1e-3, grad_topo="psum"))
+    l_state, l_metrics = lone_step(state, toks, tgts)
+    p_state, p_metrics = psum_step(state, toks, tgts)
+    jax.block_until_ready((l_state, p_state))
+    assert abs(float(l_metrics["loss"]) - float(p_metrics["loss"])) < 1e-5
+    for a, b in zip(
+        jax.tree.leaves(l_state["params"]), jax.tree.leaves(p_state["params"])
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

@@ -5,8 +5,10 @@
 # backend init doesn't hang — see bench.py::tpu_alive).  Round 3 built and
 # CPU-validated all of these generators, but the axon tunnel wedged
 # mid-round (~5h; loopback relay upstream dead), so the committed artifacts
-# may lag the code.  Each step is independently timeout-guarded and
-# skippable; partial success still commits useful evidence.
+# may lag the code.  Between steps the tunnel is re-probed (a killed-mid-
+# compile step is exactly what wedged the relay in the first place — if the
+# tunnel dies partway, bail instead of burning the remaining timeouts
+# against a dead relay); partial success still commits useful evidence.
 #
 #   BENCH_ATTENTION.json        ours vs tuned stock vs XLA, device-loop slope
 #   BENCH_REDUCE_ROOFLINE.json  pallas_reduce HBM bandwidth vs chip peak
@@ -15,7 +17,16 @@
 
 set -x
 cd "$(dirname "$0")/.."
+
+alive() {
+    python -c "import bench, sys; sys.exit(0 if bench.tpu_alive() else 1)"
+}
+
+alive || { echo "tunnel down before start; aborting"; exit 1; }
 timeout 1800 python tools/bench_attention.py || echo "bench_attention failed"
+alive || { echo "tunnel died after bench_attention; aborting"; exit 1; }
 timeout 900 python tools/roofline_reduce.py || echo "roofline failed"
+alive || { echo "tunnel died after roofline; aborting"; exit 1; }
 timeout 900 python tools/calibrate_host.py --skip-cpu || echo "tpu calibration failed"
+alive || { echo "tunnel died after calibration; aborting"; exit 1; }
 timeout 1800 python bench.py || echo "bench.py failed"
