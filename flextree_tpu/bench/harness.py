@@ -22,7 +22,6 @@ per BASELINE.md, plus min/avg wall time like ``benchmark.cpp:215``.
 from __future__ import annotations
 
 import dataclasses
-import math
 from dataclasses import dataclass
 
 import jax

@@ -13,7 +13,7 @@ missing completion gate).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 

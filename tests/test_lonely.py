@@ -134,7 +134,6 @@ class TestPlanner:
         """A plan whose argmin is a lonely shape must produce an FT_TOPO
         spec the runtime resolves and executes."""
         from flextree_tpu.planner import choose_topology
-        from flextree_tpu.planner.choose import Candidate, Plan
 
         plan = choose_topology(7, 1 << 20)
         lonely = next(c for c in plan.candidates if c.lonely)
@@ -176,7 +175,7 @@ def test_validator_accepts_lonely():
 
 
 def test_phase_apis_reject_lonely_clearly():
-    from flextree_tpu.parallel import allgather, reduce_scatter
+    from flextree_tpu.parallel import reduce_scatter
     from flextree_tpu.parallel.mesh import flat_mesh
     from jax.sharding import PartitionSpec as P
 
