@@ -174,3 +174,30 @@ def test_install_uninstall_state():
         uninstall()
     # lax.psum is the true original again
     assert not hasattr(lax.psum, "_flextree_interposer")
+
+
+def test_interposed_psum_with_lonely_topo():
+    """The psum shadow composes with executable lonely shapes: a user's
+    lax.psum call routed through FlexTree with topo="7+1" on 8 ranks must
+    produce the native sum AND actually take the lonely path — the buddy
+    fold/restore plus the restricted tree stages lower to ppermutes, so
+    the IR must contain collective_permute (a silent fallback to native
+    psum would pass the numeric check alone)."""
+    x = jnp.arange(8 * 24, dtype=jnp.float32).reshape(8, 24)
+    native = _psum_over_mesh(8, lambda v: lax.psum(v, "ft"))(x)
+    mesh = jax.make_mesh((8,), ("ft",))
+
+    def traced():
+        return jax.jit(
+            jax.shard_map(
+                lambda v: lax.psum(v, "ft"), mesh=mesh,
+                in_specs=P("ft"), out_specs=P("ft"), check_vma=False,
+            )
+        ).lower(jnp.ones((8, 24), jnp.float32)).as_text()
+
+    with interposed(topo="7+1"):
+        ours = _psum_over_mesh(8, lambda v: lax.psum(v, "ft"))(x)
+        lonely_ir = traced()
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(native), rtol=1e-6)
+    assert "collective_permute" in lonely_ir
+    assert "collective_permute" not in traced()  # scope exited -> native
