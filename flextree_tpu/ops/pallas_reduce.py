@@ -4,8 +4,9 @@ The TPU rebuild of the reference's local reduction kernels ``reduce_sum`` /
 ``reduce_band`` (``allreduce_over_mpi/mpi_mod.hpp:246-660``): there, an
 OpenMP ``parallel for simd`` over up to 20 sources with a hand-unrolled
 switch per source count; here, a single VPU kernel tiled over the payload,
-streaming ``(W, rows_tile, 128)`` blocks HBM->VMEM and writing the reduced
-``(rows_tile, 128)`` tile back.  XLA fuses this pattern well on its own —
+streaming one native 2D ``(rows_tile, 128)`` tile per source HBM->VMEM and
+folding it into a VMEM-resident accumulator that is written back once per
+output tile.  XLA fuses this pattern well on its own —
 the kernel exists because the local reduce is the allreduce's only compute
 (SURVEY §3.2 "HOT LOOP") and a hand-tiled kernel both pins the layout and
 gives the benchmark a deterministic HBM-bandwidth probe on one chip.
@@ -29,16 +30,24 @@ __all__ = ["reduce_stacked", "reduce_stacked_reference"]
 _LANE = 128
 
 
-def _kernel(x_ref, o_ref, *, w: int, jnp_name: str):
-    if jnp_name == "add":
-        # jnp.sum over the leading (source) axis vectorizes cleanly
-        o_ref[:] = jnp.sum(x_ref[:], axis=0)
-    else:
-        fn = getattr(jnp, jnp_name)
-        acc = x_ref[0]
-        for j in range(1, w):
-            acc = fn(acc, x_ref[j])
-        o_ref[:] = acc
+def _kernel(x_ref, o_ref, *, jnp_name: str):
+    # Grid is (row_tiles, sources) with the source axis fastest; the output
+    # block's index map ignores the source axis, so Pallas keeps the tile
+    # resident in VMEM across all w accumulation steps and writes it back
+    # to HBM once.  Each step streams one native 2D (rows_tile, 128) tile —
+    # no 3D blocks, no cross-sublane axis-0 reduction.
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    x = x_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[:] = x
+
+    @pl.when(j != 0)
+    def _fold():
+        o_ref[:] = getattr(jnp, jnp_name)(o_ref[:], x)
 
 
 def reduce_stacked_reference(x: jax.Array, op="sum") -> jax.Array:
@@ -86,13 +95,13 @@ def reduce_stacked(
     x3 = x.reshape(w, rows, _LANE)
 
     out = pl.pallas_call(
-        functools.partial(_kernel, w=w, jnp_name=rop.jnp_name),
+        functools.partial(_kernel, jnp_name=rop.jnp_name),
         out_shape=jax.ShapeDtypeStruct((rows, _LANE), x.dtype),
-        grid=(rows // rows_tile,),
+        grid=(rows // rows_tile, w),
         in_specs=[
-            pl.BlockSpec((w, rows_tile, _LANE), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, rows_tile, _LANE), lambda i, j: (j, i, 0)),
         ],
-        out_specs=pl.BlockSpec((rows_tile, _LANE), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((rows_tile, _LANE), lambda i, j: (i, 0)),
         interpret=interpret,
     )(x3)
     return out.reshape(padded)[:length]

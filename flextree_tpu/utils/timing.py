@@ -139,6 +139,7 @@ def time_device_loop(
     n_lo: int = 2,
     n_hi: int = 12,
     best_of: int = 4,
+    samples: int = 1,
 ) -> float:
     """Device-only per-call seconds for ``fn(x0, *rest)`` via an in-jit
     chained loop at two iteration counts.
@@ -153,7 +154,13 @@ def time_device_loop(
     run-to-run, enough to bury the kernel entirely (r02 reported 33 TFLOP/s
     for a kernel whose device time is ~95; see PROFILE_ATTENTION.md).
     Requires ``fn``'s output to match its first argument in shape/dtype.
+    ``samples > 1`` repeats the slope measurement (reusing the compiled
+    loops — recompiling per sample over a tunneled backend is both slow and
+    the kind of long in-flight compile that has wedged it) and returns the
+    median slope.
     """
+    import statistics
+
     import jax.numpy as jnp
     from jax import lax
 
@@ -168,28 +175,34 @@ def time_device_loop(
     float(loop_lo(x0, *rest))  # compile + warm
     float(loop_hi(x0, *rest))
 
-    def best(loop):
+    def best(loop, k):
         b = float("inf")
-        for _ in range(best_of):
+        for _ in range(k):
             t0 = time.perf_counter()
             float(loop(x0, *rest))
             b = min(b, time.perf_counter() - t0)
         return b
 
-    # dispatch noise can exceed the added work when fn is tiny, making the
-    # slope non-positive; retry with more best-of samples before giving up
-    # loudly rather than returning a <=0 "time" (which would publish as a
-    # negative/infinite TFLOP/s)
-    for attempt in range(3):
-        slope = (best(loop_hi) - best(loop_lo)) / (n_hi - n_lo)
-        if slope > 0:
-            return slope
-        best_of *= 2
-    raise RuntimeError(
-        f"time_device_loop: non-positive slope ({slope:.3e}s) after 3 "
-        f"attempts — fn is too small relative to dispatch noise at "
-        f"n_hi={n_hi}; raise n_hi or time it with time_jax_fn"
-    )
+    slopes = []
+    for _ in range(samples):
+        # dispatch noise can exceed the added work when fn is tiny, making
+        # the slope non-positive; retry with more best-of samples before
+        # giving up loudly rather than returning a <=0 "time" (which would
+        # publish as a negative/infinite TFLOP/s)
+        k = best_of
+        for attempt in range(3):
+            slope = (best(loop_hi, k) - best(loop_lo, k)) / (n_hi - n_lo)
+            if slope > 0:
+                break
+            k *= 2
+        else:
+            raise RuntimeError(
+                f"time_device_loop: non-positive slope ({slope:.3e}s) after "
+                f"3 attempts — fn is too small relative to dispatch noise "
+                f"at n_hi={n_hi}; raise n_hi or time it with time_jax_fn"
+            )
+        slopes.append(slope)
+    return statistics.median(slopes)
 
 
 def time_chained(fn, q, *rest, n_calls: int = 10) -> float:

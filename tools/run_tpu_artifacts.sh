@@ -25,7 +25,7 @@ alive() {
 alive || { echo "tunnel down before start; aborting"; exit 1; }
 timeout 1800 python tools/bench_attention.py || echo "bench_attention failed"
 alive || { echo "tunnel died after bench_attention; aborting"; exit 1; }
-timeout 900 python tools/roofline_reduce.py || echo "roofline failed"
+timeout 1500 python tools/roofline_reduce.py --sweep-tiles || echo "roofline failed"
 alive || { echo "tunnel died after roofline; aborting"; exit 1; }
 timeout 900 python tools/calibrate_host.py --skip-cpu || echo "tpu calibration failed"
 alive || { echo "tunnel died after calibration; aborting"; exit 1; }
