@@ -25,7 +25,11 @@ alive() {
 alive || { echo "tunnel down before start; aborting"; exit 1; }
 timeout 1800 python tools/bench_attention.py || echo "bench_attention failed"
 alive || { echo "tunnel died after bench_attention; aborting"; exit 1; }
-timeout 1500 python tools/roofline_reduce.py --sweep-tiles || echo "roofline failed"
+# 3600s: the sweep normally takes ~15 min; the generous bound exists only
+# for a genuinely hung tunnel.  A SIGTERM that lands mid-compile wedges the
+# relay (it did, twice) — so the bound must be far above any plausible slow
+# run, never a tight "should be done by now" guess.
+timeout 3600 python tools/roofline_reduce.py --sweep-tiles || echo "roofline failed"
 alive || { echo "tunnel died after roofline; aborting"; exit 1; }
 timeout 900 python tools/calibrate_host.py --skip-cpu || echo "tpu calibration failed"
 alive || { echo "tunnel died after calibration; aborting"; exit 1; }
