@@ -229,6 +229,13 @@ def main() -> int:
             "unit": f"error:{type(e).__name__}",
             "vs_baseline": 0.0,
         }
+    try:  # provenance stamp (supplementary key, reference CMakeLists:10-31)
+        sys.path.insert(0, REPO)
+        from flextree_tpu.utils.buildstamp import build_info
+
+        result.setdefault("git", build_info()["git_describe"])
+    except Exception:
+        pass
     print(json.dumps(result))
     return 0
 

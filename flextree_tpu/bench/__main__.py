@@ -58,9 +58,15 @@ def main(argv=None) -> int:
     ap.add_argument("--block-q", type=int, default=256)
     ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument(
+        "--no-attn-pipeline", action="store_true",
+        help="disable the forward k-loop software pipelining (flash impl; "
+        "ablation knob for the MXU/VPU-overlap win)",
+    )
+    ap.add_argument(
         "--attn-mode", choices=["fwd", "grad"], default="fwd",
-        help="grad: time d/dq of sum(attention) — the fwd-with-lse pass "
-        "plus both blockwise backward kernels (hw FLOPs incl. recompute)",
+        help="grad: time grads of sum(attention) wrt (q, k, v) — the "
+        "fwd-with-residuals pass plus both blockwise backward kernels "
+        "(hw FLOPs incl. recompute); flash, stock, and reference",
     )
     ap.add_argument(
         "--attn-timing", choices=["device_loop", "chained"],
@@ -81,9 +87,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.version:
-        from flextree_tpu import __version__
+        from flextree_tpu.utils.buildstamp import version_string
 
-        print(f"flextree-tpu {__version__}")
+        print(version_string())
         return 0
 
     if args.cpu:
@@ -110,6 +116,7 @@ def main(argv=None) -> int:
             block_k=args.block_k,
             timing=args.attn_timing,
             mode=args.attn_mode,
+            pipeline=not args.no_attn_pipeline,
         )
         if args.attn_timing == "chained":
             acfg_kw["repeat"] = args.repeat  # device_loop ignores repeat

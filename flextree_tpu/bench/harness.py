@@ -242,18 +242,21 @@ class AttentionBenchConfig:
     repeat: int = 20
     block_q: int = 256
     block_k: int = 512
+    # forward k-loop software pipelining (flash impl only; see
+    # flextree_tpu.ops.pallas_attention._flash_kernel)
+    pipeline: bool = True
     # "device_loop": in-jit chained fori_loop, slope of two iteration
     # counts — measures DEVICE time only, immune to the tunneled backend's
     # per-dispatch latency (the r01/r02 numbers were dominated by it; see
     # PROFILE_ATTENTION.md).  "chained": per-call python loop with a final
     # fetch — includes dispatch overhead; kept for comparison/CPU tests.
     timing: str = "device_loop"
-    # "fwd": forward only.  "grad": d/dq of sum(attention) — for flash,
-    # exercises the forward-with-lse plus both blockwise backward kernels;
-    # reported FLOPs are per-impl hardware FLOPs (flash 4.5x fwd with
-    # recompute, reference 3x — see grad_flop_scale in
-    # run_attention_bench).  flash/reference only — the stock kernel's
-    # bwd needs segment_ids plumbing we don't benchmark.
+    # "fwd": forward only.  "grad": grads of sum(attention) wrt (q, k, v) —
+    # for flash/stock, exercises the forward-with-residuals plus both
+    # blockwise backward kernels; reported FLOPs are per-impl hardware
+    # FLOPs (flash & stock 4.5x fwd — qk recomputed in both the dq and dkv
+    # kernels; reference 3x, P stored — see grad_flop_scale in
+    # run_attention_bench).
     mode: str = "fwd"
 
 
@@ -299,10 +302,39 @@ class AttentionBenchReport:
             "dtype": self.config.dtype,
             "block_q": self.config.block_q,
             "block_k": self.config.block_k,
+            "pipeline": self.config.pipeline if self.config.impl == "flash" else None,
             "per_call_s": self.per_call_s,
             "tflops": self.tflops,
             "mfu": self.mfu,
         }
+
+
+def stock_block_sizes(block_q: int, block_k: int):
+    """Full ``BlockSizes`` for the stock Pallas flash kernel, forward AND
+    backward, derived from one (block_q, block_k) pair.
+
+    The backward blocks mirror the forward derivation (``block_*_major =
+    max(block_k, block_q)``), so a single swept pair configures both
+    passes — required for the grad A/B baseline (VERDICT r3 item 3: the
+    stock bwd raises unless every backward block is set).  segment_ids
+    stays None on both sides of the A/B — we don't benchmark segmenting.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    bkM = max(block_k, block_q)
+    return BlockSizes(
+        block_q=block_q,
+        block_k_major=bkM,
+        block_k=block_k,
+        block_b=1,
+        block_q_major_dkv=block_q,
+        block_k_major_dkv=bkM,
+        block_k_dkv=block_k,
+        block_q_dkv=block_q,
+        block_k_major_dq=bkM,
+        block_k_dq=block_k,
+        block_q_dq=block_q,
+    )
 
 
 def run_attention_bench(
@@ -321,11 +353,10 @@ def run_attention_bench(
     layout_bhtd = False  # stock kernel's native layout is (B, H, T, D)
     if cfg.mode not in ("fwd", "grad"):
         raise ValueError(f"unknown mode {cfg.mode!r} (fwd|grad)")
-    if cfg.mode == "grad" and cfg.impl == "stock":
-        raise ValueError("mode='grad' supports impl flash|reference only")
     if cfg.impl == "flash":
         core = lambda q, k, v: flash_attention(  # noqa: E731
-            q, k, v, causal=True, block_q=cfg.block_q, block_k=cfg.block_k
+            q, k, v, causal=True, block_q=cfg.block_q, block_k=cfg.block_k,
+            pipeline=cfg.pipeline,
         )
         fn = None  # grad/fwd wrap below
     elif cfg.impl == "reference":
@@ -343,18 +374,14 @@ def run_attention_bench(
         )
 
         layout_bhtd = True
-        bs = BlockSizes(
-            block_q=cfg.block_q,
-            block_k_major=max(cfg.block_k, cfg.block_q),
-            block_k=cfg.block_k,
-            block_b=1,
+        bs = stock_block_sizes(cfg.block_q, cfg.block_k)
+        core = lambda q, k, v: stock_flash(  # noqa: E731
+            q, k, v, causal=True, block_sizes=bs
         )
-        fn = jax.jit(
-            lambda q, k, v: stock_flash(q, k, v, causal=True, block_sizes=bs)
-        )
+        fn = None
     else:
         raise ValueError(f"unknown attention impl {cfg.impl!r}")
-    if fn is None:  # flash/reference share the grad/fwd wrap
+    if fn is None:  # flash/reference/stock share the grad/fwd wrap
         if cfg.mode == "grad":
             g = jax.grad(lambda q, k, v: core(q, k, v).sum(), argnums=(0, 1, 2))
 
@@ -398,9 +425,11 @@ def run_attention_bench(
     # the forward (custom_vjp) then 3 dq-kernel + 4 dkv-kernel matmuls over
     # the visible tiles -> (2+3+4)/2 = 4.5x fwd; XLA autodiff of the
     # full-matrix reference stores P and does 4 backward matmuls, no
-    # recompute -> (2+4)/2 = 3x fwd
+    # recompute -> (2+4)/2 = 3x fwd.  The stock Pallas bwd has the same
+    # structure as ours (qk recomputed in both the 3-matmul dq and
+    # 4-matmul dkv kernels; fwd residuals o/l/m saved) -> 4.5x too.
     if cfg.mode == "grad":
-        grad_flop_scale = 4.5 if cfg.impl == "flash" else 3.0
+        grad_flop_scale = 3.0 if cfg.impl == "reference" else 4.5
     else:
         grad_flop_scale = 1.0
     flops = 4 * b * h * t * t * d / 2 * grad_flop_scale  # causal
@@ -429,7 +458,9 @@ def run_attention_bench(
 
 def autotune_attention(
     cfg: AttentionBenchConfig,
-    blocks: tuple[tuple[int, int], ...] = ((256, 512), (512, 512), (512, 1024)),
+    blocks: tuple[tuple[int, int], ...] = (
+        (256, 512), (512, 512), (512, 1024), (1024, 512)
+    ),
     repeat: int | None = None,
     impl: str = "flash",
 ) -> AttentionBenchReport:
@@ -437,12 +468,8 @@ def autotune_attention(
     report (VERDICT r1 item 3's autotune).  The default pairs are the top
     configs from the v5e block sweep in PROFILE_ATTENTION.md — a compile
     over the tunneled backend costs ~30 s, so the sweep is a shortlist,
-    not a product.  Works for ``impl="stock"`` too (block_k_major is
-    derived in ``run_attention_bench``)."""
-    if impl == "stock" and cfg.mode == "grad":
-        # fail here, not once per block pair — the per-combo `except` below
-        # would swallow the real error into "no configuration succeeded"
-        raise ValueError("mode='grad' supports impl flash|reference only")
+    not a product.  Works for ``impl="stock"`` too (block_k_major and the
+    backward blocks are derived in ``run_attention_bench``)."""
     rep_kw = {} if repeat is None else {"repeat": repeat}
     if impl == "reference":
         # block sizes don't reach attention_reference; sweeping them would

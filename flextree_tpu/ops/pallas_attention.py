@@ -84,6 +84,7 @@ def _flash_kernel(
     q_offset: int,
     k_offset: int,
     unroll: int = 1,
+    pipeline: bool = False,
 ):
     i = pl.program_id(1)
     # fold scale*log2(e) into q once (bq x D) instead of scaling each
@@ -162,7 +163,38 @@ def _flash_kernel(
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    carry = lax.fori_loop(0, kb_full, step_full, (m0, l0, acc0), unroll=unroll)
+    if pipeline:
+        # Software-pipelined full loop: iteration j's body computes tile
+        # j's scores (MXU, independent of the softmax carry) *and* folds
+        # tile j-1's already-computed scores into the online softmax (VPU +
+        # the p@v MXU op).  Inside one loop body the two are explicitly
+        # independent, so Mosaic can overlap them — the cross-iteration
+        # scheduling a carry-serialized ``fori_loop`` body denies it
+        # (PROFILE_ATTENTION.md §2: the ~52% ceiling assumed no MXU/VPU
+        # overlap; this is the lever that escapes it).
+        s0, vb0 = tile(0)  # safe: t_kv >= block_k always (padded geometry)
+
+        def step_pipe(j, carry):
+            m, l, acc, s_prev, vb_prev = carry
+            s_next, vb_next = tile(j)
+            m, l, acc = update((m, l, acc), s_prev, vb_prev)
+            return m, l, acc, s_next, vb_next
+
+        m, l, acc, s_last, vb_last = lax.fori_loop(
+            1, kb_full, step_pipe, (m0, l0, acc0, s0, vb0)
+        )
+        # epilogue: tile kb_full-1's scores are computed but unconsumed;
+        # fold them in — unless the full loop was empty (kb_full == 0),
+        # where the prefetched tile 0 must be discarded
+        fed = update((m, l, acc), s_last, vb_last)
+        m, l, acc = jax.tree.map(
+            lambda a, b: jnp.where(kb_full > 0, a, b), fed, (m, l, acc)
+        )
+        carry = (m, l, acc)
+    else:
+        carry = lax.fori_loop(
+            0, kb_full, step_full, (m0, l0, acc0), unroll=unroll
+        )
     m, l, acc = lax.fori_loop(kb_full, kb_hi, step_masked, carry)
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
@@ -210,6 +242,7 @@ def _from_bhd(x, b, h, t):
 def _flash_fwd_impl(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
     emit_lse: bool = False,
+    pipeline: bool = False,
 ):
     """(B, Tq, H, D) x (B, Tk, H, D)^2 -> fused attention out, plus the
     per-row logsumexp (B*H, Tq_pad) when ``emit_lse`` (else None) — the
@@ -241,6 +274,7 @@ def _flash_fwd_impl(
             q_offset=q_offset,
             k_offset=k_offset,
             unroll=_FWD_UNROLL,
+            pipeline=pipeline,
         ),
         out_shape=tuple(out_shape),
         grid=(b * h, tq_pad // bq),
@@ -520,26 +554,34 @@ def _flash_bwd_impl(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
 def _flash_attention_core(
-    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+    pipeline,
 ):
     out, _ = _flash_fwd_impl(
-        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+        q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+        pipeline=pipeline,
     )
     return out
 
 
-def _core_fwd(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret):
+def _core_fwd(
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+    pipeline,
+):
     out, lse = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-        emit_lse=True,
+        emit_lse=True, pipeline=pipeline,
     )
     return out, (q, k, v, out, lse)
 
 
-def _core_bwd(causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g):
+def _core_bwd(
+    causal, scale, q_offset, k_offset, block_q, block_k, interpret, pipeline,
+    res, g,
+):
     q, k, v, out, lse = res
     return _flash_bwd_impl(
         q, k, v, out, lse, g, None, causal, scale, q_offset, k_offset,
@@ -571,32 +613,35 @@ def _lse_from_btH(g_lse, tq_pad):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
 def _flash_attention_lse_core(
-    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+    pipeline,
 ):
     out, lse = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-        emit_lse=True,
+        emit_lse=True, pipeline=pipeline,
     )
     b, tq, h, _ = q.shape
     return out, _lse_to_btH(lse, b, h, tq)
 
 
 def _lse_core_fwd(
-    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret
+    q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+    pipeline,
 ):
     out, lse = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-        emit_lse=True,
+        emit_lse=True, pipeline=pipeline,
     )
     b, tq, h, _ = q.shape
     return (out, _lse_to_btH(lse, b, h, tq)), (q, k, v, out, lse)
 
 
 def _lse_core_bwd(
-    causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, g
+    causal, scale, q_offset, k_offset, block_q, block_k, interpret, pipeline,
+    res, g,
 ):
     q, k, v, out, lse = res
     g_out, g_lse = g
@@ -623,6 +668,7 @@ def flash_attention(
     block_k: int = 512,
     interpret: bool | None = None,
     return_lse: bool = False,
+    pipeline: bool = True,
 ):
     """Fused attention on (B, Tq, H, D) queries / (B, Tk, H, D) keys-values.
 
@@ -635,6 +681,10 @@ def flash_attention(
     masked scores, shape (B, Tq, H) float32 (fully-masked rows: -1e30) —
     differentiable, which is what lets blockwise consumers (the flash ring
     attention) merge partial attentions exactly.
+
+    ``pipeline`` software-pipelines the forward k-loop (tile j's MXU score
+    matmul issued alongside tile j-1's VPU softmax — see ``_flash_kernel``);
+    identical numerics, on by default.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
@@ -645,5 +695,5 @@ def flash_attention(
     core = _flash_attention_lse_core if return_lse else _flash_attention_core
     return core(
         q, k, v, causal, float(scale), int(q_offset), int(k_offset),
-        int(block_q), int(block_k), interpret,
+        int(block_q), int(block_k), interpret, bool(pipeline),
     )

@@ -196,11 +196,17 @@ def hybrid_mesh(
         # into the first axis, then split it back out: the result's axis 0
         # has size prod(dcn)*ici_shape[0] with granules outermost, so a
         # row-major reshape to (dcn..., ici...) keeps every dcn index on a
-        # single slice.
+        # single slice.  Granule kind: slices when the devices expose
+        # distinct slice_index (real multi-slice TPU); otherwise processes
+        # (multi-process CPU/GPU worlds set no slice_index — discovered by
+        # the executed 2-process bring-up, tools/multiproc_bringup.py).
+        slice_ids = {getattr(d, "slice_index", None) for d in devs}
+        by_process = len(slice_ids) <= 1  # no distinct slices -> processes
         g = math.prod(dcn_shape)
         dcn_full = (g,) + (1,) * (len(ici_shape) - 1)
         arr = mesh_utils.create_hybrid_device_mesh(
-            tuple(ici_shape), dcn_full, devices=devs
+            tuple(ici_shape), dcn_full, devices=devs,
+            process_is_granule=by_process,
         )
         return Mesh(arr.reshape(full_shape), axis_names)
     return Mesh(np.asarray(devs).reshape(full_shape), axis_names)

@@ -1,5 +1,6 @@
 """Utilities: timing, logging, profiling, checkpointing, result files."""
 
+from .buildstamp import artifact_meta, build_info, version_string
 from .checkpoint import (
     latest_checkpoint,
     list_checkpoints,
@@ -13,6 +14,9 @@ from .profiling import PhaseTimer, debug_dump_schedule, debug_enabled, phase_tim
 from .timing import BenchResult, Timer, time_jax_fn
 
 __all__ = [
+    "artifact_meta",
+    "build_info",
+    "version_string",
     "save_checkpoint",
     "restore_checkpoint",
     "save_train_state",

@@ -163,7 +163,9 @@ class TestBenchPyContract:
         lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
         assert len(lines) == 1, p.stdout
         payload = json.loads(lines[0])
-        assert set(payload) == {"metric", "value", "unit", "vs_baseline"}
+        # the 4 contract keys plus the git provenance stamp (the reference's
+        # CMake git stamping, CMakeLists.txt:10-31)
+        assert set(payload) == {"metric", "value", "unit", "vs_baseline", "git"}
         assert payload["metric"] != "bench_error", payload
         assert payload["value"] > 0
 
@@ -240,9 +242,12 @@ def test_attention_bench_grad_mode():
     assert rep.per_call_s > 0 and rep.tflops > 0
     assert rep.payload()["mode"] == "grad"
 
-    import pytest
+    # stock grad is wired (VERDICT r3 item 3): the derived BlockSizes must
+    # carry a complete, self-consistent backward set (the stock bwd raises
+    # at trace time otherwise; the kernel itself only runs on TPU)
+    from flextree_tpu.bench.harness import stock_block_sizes
 
-    with pytest.raises(ValueError, match="grad"):
-        run_attention_bench(
-            AttentionBenchConfig(impl="stock", mode="grad", repeat=1)
-        )
+    bs = stock_block_sizes(1024, 512)
+    assert bs.has_backward_blocks
+    assert bs.block_k_major_dq == bs.block_k_major_dkv == 1024
+    assert stock_block_sizes(256, 512).has_backward_blocks

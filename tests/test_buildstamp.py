@@ -1,0 +1,41 @@
+"""Git build stamping (the reference's CMake version stamping,
+``allreduce_over_mpi/CMakeLists.txt:10-31`` + ``benchmark.cpp:109-115``)."""
+
+from flextree_tpu.utils.buildstamp import artifact_meta, build_info, version_string
+
+
+def test_build_info_has_all_stamps():
+    info = build_info()
+    assert set(info) == {"version", "git_hash", "git_date", "git_describe"}
+    # running from the repo checkout: git fields must be real, not fallbacks
+    assert info["git_hash"] != "unknown"
+    assert len(info["git_hash"]) >= 7
+    assert info["git_date"][:2] == "20"  # ISO date
+
+
+def test_build_info_cached_and_consistent():
+    assert build_info() is build_info()
+    # describe embeds the hash (no tags in this repo -> --always form)
+    assert build_info()["git_hash"] in build_info()["git_describe"]
+
+
+def test_version_string_mentions_version_and_git():
+    from flextree_tpu import __version__
+
+    s = version_string()
+    assert __version__ in s
+    assert build_info()["git_describe"] in s
+
+
+def test_artifact_meta_adds_timestamp():
+    meta = artifact_meta()
+    assert meta["git_hash"] == build_info()["git_hash"]
+    assert "generated_at" in meta and "T" in meta["generated_at"]
+
+
+def test_bench_cli_version_flag(capsys):
+    from flextree_tpu.bench.__main__ import main
+
+    assert main(["--version"]) == 0
+    out = capsys.readouterr().out
+    assert "flextree-tpu" in out and build_info()["git_describe"] in out

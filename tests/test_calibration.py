@@ -38,7 +38,7 @@ def fitted():
     return points, params
 
 
-@pytest.mark.slow
+@pytest.mark.perf
 def test_fitted_model_rank_correlates(fitted):
     points, params = fitted
     measured = [p.measured_us for p in points]
@@ -69,7 +69,7 @@ def test_fitted_model_rank_correlates(fitted):
     assert rho >= 0.8, f"Spearman {rho:.3f} < 0.8\n{detail}"
 
 
-@pytest.mark.slow
+@pytest.mark.perf
 def test_planner_argmin_is_measured_winner(fitted):
     points, params = fitted
     for nbytes in [s * 4 for s in SIZES]:

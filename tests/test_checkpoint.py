@@ -84,6 +84,7 @@ def test_restore_train_state_empty_dir_raises(tmp_path):
         restore_train_state(tmp_path)
 
 
+@pytest.mark.slow
 def test_resume_is_exact(tmp_path):
     cfg = _cfg()
     tokens, targets = _batch(cfg)
@@ -113,6 +114,7 @@ def test_resume_is_exact(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_restore_onto_different_mesh_shape(tmp_path):
     """A checkpoint from one mesh layout must resume on another."""
     cfg = _cfg()

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute train-step tests (fast subset: -m 'not slow')
+
 from flextree_tpu.models.generate import (
     decode_step,
     generate,

@@ -108,7 +108,12 @@ class TestHybridMesh:
         devs = jax.devices()  # 8 virtual CPUs; granules = 2 fake slices of 4
         granule_of = {id(d): i // 4 for i, d in enumerate(devs)}
 
-        def fake_hybrid(mesh_shape, dcn_mesh_shape, devices=None):
+        def fake_hybrid(mesh_shape, dcn_mesh_shape, devices=None,
+                        process_is_granule=False):
+            # hybrid_mesh must ask for process granules here: these virtual
+            # devices carry no slice_index (the multi-process CPU world of
+            # tools/multiproc_bringup.py)
+            assert process_is_granule
             per = int(np.prod(mesh_shape))
             granules = [devices[i : i + per] for i in range(0, len(devices), per)]
             assert int(np.prod(dcn_mesh_shape)) == len(granules)

@@ -248,6 +248,7 @@ def test_native_choose_matches_python_incl_lonely(n):
     assert math.prod(widths) + lonely == n or widths == (1,)
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_lonely_grad_sync_through_train_step():
     """FT_TOPO=7+1 gradient sync through the production train step matches

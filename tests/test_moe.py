@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute train-step tests (fast subset: -m 'not slow')
 from jax.sharding import PartitionSpec as P
 
 from flextree_tpu.models.moe import (

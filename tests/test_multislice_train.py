@@ -58,6 +58,7 @@ def setup():
     return mesh, plan, state, tokens, targets
 
 
+@pytest.mark.slow
 def test_planner_picked_tree_sync_matches_psum(setup):
     mesh, plan, state, tokens, targets = setup
     tree_step = make_train_step(

@@ -77,6 +77,7 @@ def test_flash_fully_masked_rows_are_zero():
     np.testing.assert_array_equal(np.asarray(out), np.zeros_like(np.asarray(out)))
 
 
+@pytest.mark.slow
 def test_flash_gradients_match_reference():
     q, k, v = _qkv(t=32)
     g_f = jax.grad(
@@ -164,6 +165,7 @@ def test_ulysses_unknown_impl_raises():
         )(q, k, v)
 
 
+@pytest.mark.slow
 def test_flash_gradients_with_offsets_and_cross_lengths():
     b, h, d = 2, 4, 16
     q, k, v = _qkv(b=b, t=32, tk=64, h=h, d=d)
@@ -191,6 +193,7 @@ def test_flash_gradients_with_offsets_and_cross_lengths():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_flash_gradients_nondivisible_tail():
     q, k, v = _qkv(t=50)  # needs padding at block 16
     g_f = jax.grad(
@@ -223,6 +226,7 @@ def test_flash_gradients_noncausal():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_flash_gradients_fully_masked_are_zero():
     q, k, v = _qkv(t=16)
     g = jax.grad(
@@ -238,6 +242,7 @@ def test_flash_gradients_fully_masked_are_zero():
         np.testing.assert_array_equal(np.asarray(a), np.zeros_like(np.asarray(a)))
 
 
+@pytest.mark.slow
 def test_train_step_with_flash_attention_matches_reference_impl():
     """End-to-end: a train step with attn_impl='flash' (no sp axis) equals
     the reference-impl step on the same data."""
@@ -311,6 +316,7 @@ def test_ring_flash_matches_reference(sp, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_flash_gradients_match_reference():
     from flextree_tpu.parallel.ring_attention import ring_attention
 
@@ -349,6 +355,7 @@ def test_ring_flash_unknown_impl_raises():
         )(q, k, v)
 
 
+@pytest.mark.slow
 def test_forward_ring_flash_matches_reference():
     cfg = TransformerConfig(
         vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute train-step tests (fast subset: -m 'not slow')
+
 from flextree_tpu.models.transformer import TransformerConfig, init_params
 from flextree_tpu.parallel.pipeline import (
     factor_devices_4d,

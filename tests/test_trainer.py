@@ -10,6 +10,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute train-step tests (fast subset: -m 'not slow')
+
 from flextree_tpu.data import LMDataset, prefetch, synthetic_tokens
 from flextree_tpu.models.transformer import TransformerConfig
 from flextree_tpu.parallel.loop import FitConfig, fit

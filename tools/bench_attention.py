@@ -54,11 +54,21 @@ def main() -> int:
     for name, kw in {
         "ours_256_512": dict(impl="flash", block_q=256, block_k=512),
         "ours_512_512": dict(impl="flash", block_q=512, block_k=512),
+        "ours_1024_512": dict(impl="flash", block_q=1024, block_k=512),
+        "ours_256_512_nopipe": dict(
+            impl="flash", block_q=256, block_k=512, pipeline=False
+        ),
         "stock_tuned_1024_512": dict(impl="stock", block_q=1024, block_k=512),
         "stock_default_shape_512": dict(impl="stock", block_q=512, block_k=512),
         "xla_full_matrix": dict(impl="reference"),
         "ours_grad_256_512": dict(
             impl="flash", block_q=256, block_k=512, mode="grad"
+        ),
+        "stock_grad_1024_512": dict(
+            impl="stock", block_q=1024, block_k=512, mode="grad"
+        ),
+        "stock_grad_512_512": dict(
+            impl="stock", block_q=512, block_k=512, mode="grad"
         ),
     }.items():
         try:
@@ -68,9 +78,24 @@ def main() -> int:
             entries[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
         print(f"{name}: {entries[name].get('tflops', 'FAIL')}", flush=True)
 
-    ours = entries.get("ours_256_512", {}).get("tflops")
+    from flextree_tpu.utils.buildstamp import artifact_meta
+
+    # ours = best autotuned pipelined config (what bench.py ships); the
+    # nopipe ablation is context, not a candidate
+    ours = max(
+        (entries.get(k, {}).get("tflops") or 0.0
+         for k in ("ours_256_512", "ours_512_512", "ours_1024_512")),
+        default=0.0,
+    ) or None
     stock = entries.get("stock_tuned_1024_512", {}).get("tflops")
+    ours_g = entries.get("ours_grad_256_512", {}).get("tflops")
+    stock_g = max(
+        (entries.get(k, {}).get("tflops") or 0.0
+         for k in ("stock_grad_1024_512", "stock_grad_512_512")),
+        default=0.0,
+    ) or None
     doc = {
+        "build": artifact_meta(),
         "description": "Causal bf16 attention A/B (B=4 T=4096 H=16 D=128), "
         "device-loop slope timing (flextree_tpu.utils.timing."
         "time_device_loop); median of per-config samples. See "
@@ -80,6 +105,9 @@ def main() -> int:
         "chip_peak_bf16_tflops": peak,
         "samples_per_config": args.samples,
         "vs_tuned_stock": round(ours / stock, 3) if ours and stock else None,
+        "vs_tuned_stock_grad": (
+            round(ours_g / stock_g, 3) if ours_g and stock_g else None
+        ),
         "entries": entries,
     }
     with open(args.out, "w") as f:

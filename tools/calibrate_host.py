@@ -35,6 +35,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from flextree_tpu.utils.buildstamp import artifact_meta  # noqa: E402
+
 
 def cpu_section(out: str) -> None:
     """Fit on the 8-vdev CPU mesh in THIS process (cpu-pinned)."""
@@ -57,6 +59,7 @@ def cpu_section(out: str) -> None:
         params,
         backend="cpu",
         meta={
+            "build": artifact_meta(),
             "date": datetime.date.today().isoformat(),
             "host": platform.platform(),
             "cpus": os.cpu_count(),
@@ -79,17 +82,24 @@ import jax
 assert any(d.platform != "cpu" for d in jax.devices())
 sys.path.insert(0, {os.path.join(REPO, "tools")!r})
 from roofline_reduce import chip_peak_hbm_GBps, measure_point
+from measure_launch import measure_launch_bracket
 # the allreduce reduce term folds w copies; w=8 at 64 MB is the
 # representative point (BASELINE.md config sizes) — large enough that the
 # slope subtraction is stable (16 MB samples swing 190-580 GB/s run to
 # run); median of 5 full slope samples
 dt, gbps, isolated = measure_point(w=8, length=1 << 24, dtype_name="float32",
                                    rows_tile=1024, samples=5)
+try:
+    launch = measure_launch_bracket()
+except Exception as e:  # supplementary: never lose the reduce_bw result
+    print("launch bracket failed:", e, file=sys.stderr)
+    launch = {{}}
 print("RESULT " + json.dumps({{
     "achieved_GBps": gbps,
     "peak_GBps": chip_peak_hbm_GBps(),
     "device": jax.devices()[0].device_kind,
     "isolated": isolated,
+    "launch": launch,
 }}))
 """
     try:
@@ -131,12 +141,17 @@ print("RESULT " + json.dumps({{
         else "tpu_" + "".join(c if c.isalnum() else "_" for c in r["device"].lower())
     )
 
-    params = TpuCostParams(reduce_bw_GBps=round(r["achieved_GBps"], 1))
+    launch = r.get("launch", {})
+    params = TpuCostParams(
+        reduce_bw_GBps=round(r["achieved_GBps"], 1),
+        launch_us=launch.get("launch_us", TpuCostParams().launch_us),
+    )
     save_calibration(
         out,
         params,
         backend=section,
         meta={
+            "build": artifact_meta(),
             "date": datetime.date.today().isoformat(),
             "device": r["device"],
             "protocol": "reduce_bw_GBps = pallas_reduce roofline, w=8 x "
@@ -149,12 +164,18 @@ print("RESULT " + json.dumps({{
                 "reduce_bw_GBps": "measured on the attached chip",
                 "ici_*": f"datasheet default ({ICI_DEFAULT})",
                 "dcn_*": f"datasheet default ({DCN_DEFAULT})",
-                "launch_us/control_us_per_width": "default (single chip "
-                "cannot measure multi-chip dispatch)",
+                "launch_us": "measured: " + launch.get(
+                    "provenance", "bracket unavailable (kept default)"
+                ) if launch else "default (measurement failed)",
+                "control_us_per_width": "default (single chip cannot "
+                "measure multi-chip group-control scaling)",
             },
         },
     )
-    print(f"{section} section written: reduce_bw={params.reduce_bw_GBps} GB/s")
+    print(
+        f"{section} section written: reduce_bw={params.reduce_bw_GBps} GB/s, "
+        f"launch={params.launch_us} us"
+    )
     return True
 
 

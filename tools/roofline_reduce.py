@@ -114,12 +114,16 @@ def measure_copy_ceiling(length: int, n_lo: int = 2, n_hi: int = 10,
 
 
 def measure_xla_fused_sum(w: int, length: int, n_lo: int = 2, n_hi: int = 10,
-                          samples: int = 3) -> float:
+                          samples: int = 3) -> tuple[float, bool]:
     """Achieved GB/s of XLA's own fused ``jnp.sum(x, axis=0)`` over the same
     (w, L) f32 fold — the no-hand-kernel baseline the Pallas kernel must
     beat to justify existing.  Chain-isolated exactly like the Pallas rows:
     the kernel-free DUS chain (``measure_base``) is measured on the same
-    input and subtracted, so the comparison is symmetric."""
+    input and subtracted, so the comparison is symmetric.
+
+    Returns ``(GBps, isolated)``: ``isolated=False`` means the base
+    subtraction was unusable and the number carries the uncorrected
+    full-chain slope (understated), mirroring ``measure_point``."""
     import jax.numpy as jnp
     import numpy as np
     from jax import lax
@@ -138,9 +142,10 @@ def measure_xla_fused_sum(w: int, length: int, n_lo: int = 2, n_hi: int = 10,
     t_full = time_device_loop(body, x, n_lo=n_lo, n_hi=n_hi, samples=samples)
     t_base = measure_base(x, n_lo=n_lo, n_hi=n_hi, samples=samples)
     t = t_full - t_base
+    isolated = t_base > 0.0 and t > 0
     if t <= 0:
         t = t_full
-    return (w + 1) * length * 4 / t / 1e9
+    return (w + 1) * length * 4 / t / 1e9, isolated
 
 
 def measure_base(x, n_lo: int = 2, n_hi: int = 10, samples: int = 1) -> float:
@@ -246,9 +251,10 @@ def main() -> int:
         return 1
     peak = chip_peak_hbm_GBps()
     copy_gbps = measure_copy_ceiling(args.length)
-    xla_gbps = measure_xla_fused_sum(8, args.length)
+    xla_gbps, xla_isolated = measure_xla_fused_sum(8, args.length)
     print(f"copy ceiling: {copy_gbps:.0f} GB/s; XLA fused sum w=8: "
-          f"{xla_gbps:.0f} GB/s")
+          f"{xla_gbps:.0f} GB/s"
+          + ("" if xla_isolated else "  [NOT chain-isolated]"))
     tiles = (256, 512, 1024) if args.sweep_tiles else (512,)
     rows = []
     for w in (2, 4, 8):
@@ -279,13 +285,17 @@ def main() -> int:
             print(f"w={w} {dtype_name} (rows_tile={rt}): {gbps:.0f} GB/s"
                   + (f" ({gbps / peak * 100:.0f}% of peak)" if peak else "")
                   + ("" if isolated else "  [NOT chain-isolated]"))
+    from flextree_tpu.utils.buildstamp import artifact_meta
+
     doc = {
         "description": "pallas_reduce (local reduction, the allreduce hot "
                        "loop) achieved HBM bandwidth vs chip roofline",
+        "build": artifact_meta(),
         "device_kind": getattr(dev, "device_kind", str(dev)),
         "peak_hbm_GBps": peak,
         "measured_copy_ceiling_GBps": round(copy_gbps, 1),
         "xla_fused_sum_w8_GBps": round(xla_gbps, 1),
+        "xla_fused_sum_isolated": xla_isolated,
         "ceiling_note": "a pure-copy Pallas kernel (read+write) achieves "
                         "measured_copy_ceiling_GBps on this chip/backend — "
                         "the practical streaming ceiling; frac_of_peak is "

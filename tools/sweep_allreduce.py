@@ -198,10 +198,13 @@ def main() -> int:
                     f"{r['psum_bus_GBps']} GB/s)",
                     flush=True,
                 )
+    from flextree_tpu.utils.buildstamp import artifact_meta
+
     doc = {
         "description": "FlexTree allreduce vs lax.psum, BASELINE.md config "
                        "matrix on virtual CPU-device meshes (the reference's "
                        "--comm-type A/B, benchmark.cpp:147-174)",
+        "build": artifact_meta(),
         "protocol": "in-place chained timing with buffer donation on the "
                     "flextree side; psum baseline takes best of donated and "
                     "non-donated (see flextree_tpu/bench/harness.py)",
