@@ -58,9 +58,10 @@ def main(argv=None) -> int:
     ap.add_argument("--block-q", type=int, default=256)
     ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument(
-        "--no-attn-pipeline", action="store_true",
-        help="disable the forward k-loop software pipelining (flash impl; "
-        "ablation knob for the MXU/VPU-overlap win)",
+        "--attn-variant", choices=["loop", "pipelined", "kvgrid"],
+        default="pipelined",
+        help="flash forward k-walk structure (ablation knob for the "
+        "MXU/VPU-overlap win; loop = the carry-serialized r03 kernel)",
     )
     ap.add_argument(
         "--attn-mode", choices=["fwd", "grad"], default="fwd",
@@ -116,7 +117,7 @@ def main(argv=None) -> int:
             block_k=args.block_k,
             timing=args.attn_timing,
             mode=args.attn_mode,
-            pipeline=not args.no_attn_pipeline,
+            variant=args.attn_variant,
         )
         if args.attn_timing == "chained":
             acfg_kw["repeat"] = args.repeat  # device_loop ignores repeat

@@ -242,9 +242,9 @@ class AttentionBenchConfig:
     repeat: int = 20
     block_q: int = 256
     block_k: int = 512
-    # forward k-loop software pipelining (flash impl only; see
-    # flextree_tpu.ops.pallas_attention._flash_kernel)
-    pipeline: bool = True
+    # forward k-walk structure (flash impl only): "loop" | "pipelined" |
+    # "kvgrid" — see flextree_tpu.ops.pallas_attention.flash_attention
+    variant: str = "pipelined"
     # "device_loop": in-jit chained fori_loop, slope of two iteration
     # counts — measures DEVICE time only, immune to the tunneled backend's
     # per-dispatch latency (the r01/r02 numbers were dominated by it; see
@@ -302,7 +302,7 @@ class AttentionBenchReport:
             "dtype": self.config.dtype,
             "block_q": self.config.block_q,
             "block_k": self.config.block_k,
-            "pipeline": self.config.pipeline if self.config.impl == "flash" else None,
+            "variant": self.config.variant if self.config.impl == "flash" else None,
             "per_call_s": self.per_call_s,
             "tflops": self.tflops,
             "mfu": self.mfu,
@@ -356,7 +356,7 @@ def run_attention_bench(
     if cfg.impl == "flash":
         core = lambda q, k, v: flash_attention(  # noqa: E731
             q, k, v, causal=True, block_q=cfg.block_q, block_k=cfg.block_k,
-            pipeline=cfg.pipeline,
+            variant=cfg.variant,
         )
         fn = None  # grad/fwd wrap below
     elif cfg.impl == "reference":

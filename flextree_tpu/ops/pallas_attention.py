@@ -85,7 +85,7 @@ def _flash_kernel(
     k_offset: int,
     unroll: int = 1,
     pipeline: bool = False,
-):
+):  # variant="loop"/"pipelined" kernel; the "kvgrid" variant is below
     i = pl.program_id(1)
     # fold scale*log2(e) into q once (bq x D) instead of scaling each
     # (bq x bk) score tile, and run the online softmax in the exp2 domain —
@@ -122,22 +122,7 @@ def _flash_kernel(
         return s, vb
 
     def update(carry, s, vb, valid=None):
-        m, l, acc = carry
-        if valid is not None:
-            s = jnp.where(valid, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp2(s - m_new)
-        if valid is not None:
-            p = jnp.where(valid, p, 0.0)
-        corr = jnp.exp2(m - m_new)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        # probabilities drop to v's dtype for the MXU (standard flash
-        # practice; exact when v is f32, ~1e-2 abs err in bf16)
-        acc_new = acc * corr + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc_new
+        return _kv_update(*carry, s, vb, valid)
 
     def step_full(j, carry):
         s, vb = tile(j)
@@ -212,6 +197,166 @@ def _flash_kernel(
         maybe_lse_ref[0][0] = jnp.broadcast_to(lse, (block_q, _LANE))
 
 
+def _flash_kernel_kvgrid(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    *rest_refs,
+    block_q: int,
+    block_k: int,
+    block_k_major: int,
+    t_kv: int,
+    t_kv_valid: int,
+    causal: bool,
+    scale: float,
+    q_offset: int,
+    k_offset: int,
+):
+    """The "kvgrid" forward: k/v-major tiles are a GRID dimension, not a
+    ``fori_loop``.
+
+    The softmax carry (m, l, acc) lives in VMEM scratch across the
+    ``arbitrary``-semantics kv axis, each grid step's inner walk over
+    ``block_k`` minor tiles is a *statically unrolled* Python loop, and
+    k/v blocks arrive by BlockSpec DMA — so Mosaic sees straight-line code
+    per step, double-buffers the k/v fetches across steps, and can overlap
+    tile t+1's DMA/matmul with tile t's softmax.  This is the structure
+    the stock Pallas TPU flash kernel uses; the ``loop`` variant's dynamic
+    trip count denies Mosaic all of it (PROFILE_ATTENTION.md §2/§4).
+    Causally-invisible (i, j) grid steps skip compute under ``pl.when``
+    (their k/v DMA still happens — same total traffic as the loop
+    variant's whole-k/v residency).
+    """
+    has_lse = len(rest_refs) == 4
+    if has_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest_refs
+    else:
+        acc_ref, m_ref, l_ref = rest_refs
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = t_kv // block_k_major
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, _NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    if causal:
+        # exclusive bound of visible local k positions for this q tile
+        hi = q_offset + (i + 1) * block_q - k_offset
+        run = (j * block_k_major) < hi
+        # last kv-major tile with any visible position — where the output
+        # is finalized (0 when nothing is visible: zero acc, l=0 path)
+        j_last = jnp.clip(-(-hi // block_k_major) - 1, 0, n_j - 1)
+        # fully-visible prefix (min over the tile's rows), for mask skipping
+        lo_vis = q_offset + i * block_q - k_offset + 1
+    else:
+        run = True
+        j_last = n_j - 1
+        lo_vis = t_kv
+
+    def _body():
+        q = q_ref[0] * (scale * _LOG2E)
+        m = m_ref[:, 0:1]
+        l = l_ref[:, 0:1]
+        acc = acc_ref[...]
+        for jj in range(block_k_major // block_k):
+            base = j * block_k_major + jj * block_k  # local k index (traced)
+            kb = k_ref[0, jj * block_k:(jj + 1) * block_k, :]
+            vb = v_ref[0, jj * block_k:(jj + 1) * block_k, :]
+            # the score matmul lives INSIDE the branches so a skipped minor
+            # tile (fully invisible: beyond the causal bound or entirely in
+            # the pad) costs neither MXU nor VPU work — with
+            # block_k_major > block_k the last visible major tile otherwise
+            # computes up to (bkM - bk) columns of zeros per q tile
+            visible = base < t_kv_valid
+            if causal:
+                visible = visible & (base < hi)
+            needs_mask = base + block_k > t_kv_valid
+            if causal:
+                needs_mask = needs_mask | (base + block_k > lo_vis)
+
+            def scores(q):
+                return jax.lax.dot_general(
+                    q, kb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            def masked(op):
+                m, l, acc, q = op
+                s = scores(q)
+                kpos = base + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                valid = kpos < t_kv_valid
+                if causal:
+                    qpos = (
+                        q_offset - k_offset + i * block_q
+                        + lax.broadcasted_iota(
+                            jnp.int32, (block_q, block_k), 0
+                        )
+                    )
+                    valid = valid & (qpos >= kpos)
+                return _kv_update(m, l, acc, s, vb, valid)
+
+            def unmasked(op):
+                m, l, acc, q = op
+                return _kv_update(m, l, acc, scores(q), vb, None)
+
+            def folded(op):
+                return lax.cond(needs_mask, masked, unmasked, op)
+
+            m, l, acc = lax.cond(
+                visible, folded, lambda op: op[:3], (m, l, acc, q)
+            )
+        m_ref[...] = jnp.broadcast_to(m, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l, l_ref.shape)
+        acc_ref[...] = acc
+
+    if causal:
+        pl.when(run)(_body)
+    else:
+        _body()
+
+    @pl.when(j == j_last)
+    def _finalize():
+        m = m_ref[:, 0:1]
+        l = l_ref[:, 0:1]
+        acc = acc_ref[...]
+        out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+        o_ref[0] = out.astype(o_ref.dtype)
+        if has_lse:
+            lse = jnp.where(
+                l > 0,
+                (m + jnp.log2(jnp.maximum(l, 1e-38))) * (1.0 / _LOG2E),
+                -_NEG_INF,
+            )
+            lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LANE))
+
+
+def _kv_update(m, l, acc, s, vb, valid):
+    """One online-softmax fold — THE implementation, shared by every
+    forward variant (``_flash_kernel`` wraps it as ``update``); a numerics
+    change here changes all three schedules identically.  Probabilities
+    drop to v's dtype for the MXU (standard flash practice; exact when v
+    is f32, ~1e-2 abs err in bf16)."""
+    if valid is not None:
+        s = jnp.where(valid, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp2(s - m_new)
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    corr = jnp.exp2(m - m_new)
+    l_new = l * corr + p.sum(axis=-1, keepdims=True)
+    acc_new = acc * corr + jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
 def _blocks(q, k, block_q, block_k):
     """Resolved (bq, bk, tq_pad, tk_pad, interpret-independent) geometry.
 
@@ -242,11 +387,18 @@ def _from_bhd(x, b, h, t):
 def _flash_fwd_impl(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
     emit_lse: bool = False,
-    pipeline: bool = False,
+    variant: str = "pipelined",
 ):
     """(B, Tq, H, D) x (B, Tk, H, D)^2 -> fused attention out, plus the
     per-row logsumexp (B*H, Tq_pad) when ``emit_lse`` (else None) — the
-    primal/inference path skips that extra HBM store entirely."""
+    primal/inference path skips that extra HBM store entirely.
+
+    ``variant``: "loop" (carry-serialized fori_loop), "pipelined"
+    (software-pipelined fori_loop), or "kvgrid" (k/v walk as a grid axis
+    with VMEM scratch carry — see ``_flash_kernel_kvgrid``).
+    """
+    if variant not in ("loop", "pipelined", "kvgrid"):
+        raise ValueError(f"unknown flash variant {variant!r}")
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if interpret is None:
@@ -255,37 +407,96 @@ def _flash_fwd_impl(
     q3, k3, v3 = _to_bhd(q, tq_pad), _to_bhd(k, tk_pad), _to_bhd(v, tk_pad)
 
     out_shape = [jax.ShapeDtypeStruct((b * h, tq_pad, d), q.dtype)]
-    out_specs = [pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0))]
-    if emit_lse:
-        out_shape.append(
-            jax.ShapeDtypeStruct((b * h, tq_pad, _LANE), jnp.float32)
-        )
-        out_specs.append(pl.BlockSpec((1, bq, _LANE), lambda bh, i: (bh, i, 0)))
+    if variant == "kvgrid":
+        out_specs = [pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))]
+        if emit_lse:
+            out_shape.append(
+                jax.ShapeDtypeStruct((b * h, tq_pad, _LANE), jnp.float32)
+            )
+            out_specs.append(
+                pl.BlockSpec((1, bq, _LANE), lambda bh, i, j: (bh, i, 0))
+            )
+        from jax.experimental.pallas import tpu as pltpu
 
-    res = pl.pallas_call(
-        functools.partial(
-            _flash_kernel,
-            block_q=bq,
-            block_k=bk,
-            t_kv=tk_pad,
-            t_kv_valid=tk,
-            causal=causal,
-            scale=scale,
-            q_offset=q_offset,
-            k_offset=k_offset,
-            unroll=_FWD_UNROLL,
-            pipeline=pipeline,
-        ),
-        out_shape=tuple(out_shape),
-        grid=(b * h, tq_pad // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
-        ],
-        out_specs=tuple(out_specs),
-        interpret=interpret,
-    )(q3, k3, v3)
+        try:
+            compiler_params = pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        except AttributeError:  # pragma: no cover - older naming
+            compiler_params = pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        # k/v-major DMA granule: up to 4 minor tiles (<= 2048 rows) per
+        # grid step, statically unrolled in the kernel — bigger transfers
+        # for the pipeline to double-buffer, with per-minor-tile compute
+        # skip keeping the causal diagonal cheap
+        n_minor = tk_pad // bk
+        u = next(
+            u for u in (4, 2, 1) if n_minor % u == 0 and bk * u <= 2048
+        )
+        bkM = bk * u
+        res = pl.pallas_call(
+            functools.partial(
+                _flash_kernel_kvgrid,
+                block_q=bq,
+                block_k=bk,
+                block_k_major=bkM,
+                t_kv=tk_pad,
+                t_kv_valid=tk,
+                causal=causal,
+                scale=scale,
+                q_offset=q_offset,
+                k_offset=k_offset,
+            ),
+            out_shape=tuple(out_shape),
+            grid=(b * h, tq_pad // bq, tk_pad // bkM),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bkM, d), lambda bh, i, j: (bh, j, 0)),
+                pl.BlockSpec((1, bkM, d), lambda bh, i, j: (bh, j, 0)),
+            ],
+            out_specs=tuple(out_specs),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),      # acc
+                pltpu.VMEM((bq, _LANE), jnp.float32),  # m
+                pltpu.VMEM((bq, _LANE), jnp.float32),  # l
+            ],
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(q3, k3, v3)
+    else:
+        out_specs = [pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0))]
+        if emit_lse:
+            out_shape.append(
+                jax.ShapeDtypeStruct((b * h, tq_pad, _LANE), jnp.float32)
+            )
+            out_specs.append(
+                pl.BlockSpec((1, bq, _LANE), lambda bh, i: (bh, i, 0))
+            )
+        res = pl.pallas_call(
+            functools.partial(
+                _flash_kernel,
+                block_q=bq,
+                block_k=bk,
+                t_kv=tk_pad,
+                t_kv_valid=tk,
+                causal=causal,
+                scale=scale,
+                q_offset=q_offset,
+                k_offset=k_offset,
+                unroll=_FWD_UNROLL,
+                pipeline=variant == "pipelined",
+            ),
+            out_shape=tuple(out_shape),
+            grid=(b * h, tq_pad // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+                pl.BlockSpec((1, tk_pad, d), lambda bh, i: (bh, 0, 0)),
+            ],
+            out_specs=tuple(out_specs),
+            interpret=interpret,
+        )(q3, k3, v3)
     if emit_lse:
         out, lse = res
         # store only one lane's row as the residual (128x smaller); the
@@ -558,28 +769,28 @@ def _flash_bwd_impl(
 )
 def _flash_attention_core(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-    pipeline,
+    variant,
 ):
     out, _ = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-        pipeline=pipeline,
+        variant=variant,
     )
     return out
 
 
 def _core_fwd(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-    pipeline,
+    variant,
 ):
     out, lse = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-        emit_lse=True, pipeline=pipeline,
+        emit_lse=True, variant=variant,
     )
     return out, (q, k, v, out, lse)
 
 
 def _core_bwd(
-    causal, scale, q_offset, k_offset, block_q, block_k, interpret, pipeline,
+    causal, scale, q_offset, k_offset, block_q, block_k, interpret, variant,
     res, g,
 ):
     q, k, v, out, lse = res
@@ -617,11 +828,11 @@ def _lse_from_btH(g_lse, tq_pad):
 )
 def _flash_attention_lse_core(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-    pipeline,
+    variant,
 ):
     out, lse = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-        emit_lse=True, pipeline=pipeline,
+        emit_lse=True, variant=variant,
     )
     b, tq, h, _ = q.shape
     return out, _lse_to_btH(lse, b, h, tq)
@@ -629,18 +840,18 @@ def _flash_attention_lse_core(
 
 def _lse_core_fwd(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-    pipeline,
+    variant,
 ):
     out, lse = _flash_fwd_impl(
         q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
-        emit_lse=True, pipeline=pipeline,
+        emit_lse=True, variant=variant,
     )
     b, tq, h, _ = q.shape
     return (out, _lse_to_btH(lse, b, h, tq)), (q, k, v, out, lse)
 
 
 def _lse_core_bwd(
-    causal, scale, q_offset, k_offset, block_q, block_k, interpret, pipeline,
+    causal, scale, q_offset, k_offset, block_q, block_k, interpret, variant,
     res, g,
 ):
     q, k, v, out, lse = res
@@ -668,7 +879,7 @@ def flash_attention(
     block_k: int = 512,
     interpret: bool | None = None,
     return_lse: bool = False,
-    pipeline: bool = True,
+    variant: str = "pipelined",
 ):
     """Fused attention on (B, Tq, H, D) queries / (B, Tk, H, D) keys-values.
 
@@ -682,9 +893,12 @@ def flash_attention(
     differentiable, which is what lets blockwise consumers (the flash ring
     attention) merge partial attentions exactly.
 
-    ``pipeline`` software-pipelines the forward k-loop (tile j's MXU score
-    matmul issued alongside tile j-1's VPU softmax — see ``_flash_kernel``);
-    identical numerics, on by default.
+    ``variant`` selects the forward k-walk structure — identical numerics:
+    "loop" (carry-serialized fori_loop, the r03 kernel), "pipelined"
+    (software-pipelined fori_loop: tile j's MXU score matmul issued
+    alongside tile j-1's VPU softmax; default), "kvgrid" (k/v tiles as a
+    grid axis with VMEM scratch carry and BlockSpec-DMA'd k/v — Mosaic
+    pipelines grid steps).  The backward kernels are shared.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
@@ -695,5 +909,5 @@ def flash_attention(
     core = _flash_attention_lse_core if return_lse else _flash_attention_core
     return core(
         q, k, v, causal, float(scale), int(q_offset), int(k_offset),
-        int(block_q), int(block_k), interpret, bool(pipeline),
+        int(block_q), int(block_k), interpret, str(variant),
     )

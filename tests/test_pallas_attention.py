@@ -108,6 +108,50 @@ def test_flash_bf16_close_to_f32():
     )
 
 
+@pytest.mark.parametrize("variant", ["pipelined", "kvgrid"])  # vs loop ref
+@pytest.mark.parametrize(
+    "tq,tk,causal,qo,ko",
+    [
+        (48, 48, True, 0, 0),
+        (48, 48, False, 0, 0),
+        (100, 100, True, 0, 0),      # ragged tail padding
+        (32, 96, True, 64, 0),       # shifted q block (Ulysses geometry)
+        (32, 96, True, 0, 64),       # k ahead of q: some tiles see nothing
+        (16, 96, True, 0, 80),       # FULLY masked: every output row zero
+    ],
+)
+def test_flash_variants_parity(variant, tq, tk, causal, qo, ko):
+    """The three forward k-walk structures (carry loop, software-pipelined
+    loop, kv-grid with scratch carry) are alternate schedules of identical
+    math — outputs, lse, and grads must match the loop variant exactly,
+    across ragged/offset/fully-masked geometry."""
+    q, k, v = _qkv(b=1, t=tq, tk=tk, h=2, d=16)
+    kw = dict(causal=causal, q_offset=qo, k_offset=ko, block_q=16, block_k=16)
+    ref, ref_lse = flash_attention(q, k, v, variant="loop", return_lse=True, **kw)
+    out, lse = flash_attention(q, k, v, variant=variant, return_lse=True, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=1e-6)
+    if ko > qo + tq - 1:  # fully masked — zeros, not NaNs (l == 0 path)
+        assert float(jnp.abs(out).max()) == 0.0
+
+    g_ref = jax.grad(
+        lambda *a: flash_attention(*a, variant="loop", **kw).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g = jax.grad(
+        lambda *a: flash_attention(*a, variant=variant, **kw).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_flash_rejects_unknown_variant():
+    q, k, v = _qkv(b=1, t=16, h=2, d=16)
+    with pytest.raises(ValueError, match="variant"):
+        flash_attention(q, k, v, variant="nope")
+
+
 def test_flash_rejects_bad_shapes():
     q, k, v = _qkv()
     with pytest.raises(ValueError, match="B, T, H, D"):

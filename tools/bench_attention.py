@@ -55,8 +55,14 @@ def main() -> int:
         "ours_256_512": dict(impl="flash", block_q=256, block_k=512),
         "ours_512_512": dict(impl="flash", block_q=512, block_k=512),
         "ours_1024_512": dict(impl="flash", block_q=1024, block_k=512),
-        "ours_256_512_nopipe": dict(
-            impl="flash", block_q=256, block_k=512, pipeline=False
+        "ours_256_512_loop": dict(
+            impl="flash", block_q=256, block_k=512, variant="loop"
+        ),
+        "ours_kvgrid_256_512": dict(
+            impl="flash", block_q=256, block_k=512, variant="kvgrid"
+        ),
+        "ours_kvgrid_1024_512": dict(
+            impl="flash", block_q=1024, block_k=512, variant="kvgrid"
         ),
         "stock_tuned_1024_512": dict(impl="stock", block_q=1024, block_k=512),
         "stock_default_shape_512": dict(impl="stock", block_q=512, block_k=512),
@@ -80,11 +86,12 @@ def main() -> int:
 
     from flextree_tpu.utils.buildstamp import artifact_meta
 
-    # ours = best autotuned pipelined config (what bench.py ships); the
-    # nopipe ablation is context, not a candidate
+    # ours = best autotunable config (what bench.py ships); the loop
+    # ablation is context, not a candidate
     ours = max(
         (entries.get(k, {}).get("tflops") or 0.0
-         for k in ("ours_256_512", "ours_512_512", "ours_1024_512")),
+         for k in ("ours_256_512", "ours_512_512", "ours_1024_512",
+                   "ours_kvgrid_256_512", "ours_kvgrid_1024_512")),
         default=0.0,
     ) or None
     stock = entries.get("stock_tuned_1024_512", {}).get("tflops")
