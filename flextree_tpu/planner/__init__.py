@@ -36,6 +36,7 @@ from .factorize import (
     count_ordered_factorizations,
     is_prime,
     ordered_factorizations,
+    ordered_factorizations_combinatoric,
     prime_factors,
 )
 from .shapes import format_shape, parse_shape, shape_taxonomy
@@ -71,6 +72,7 @@ __all__ = [
     "count_ordered_factorizations",
     "is_prime",
     "ordered_factorizations",
+    "ordered_factorizations_combinatoric",
     "prime_factors",
     "format_shape",
     "parse_shape",

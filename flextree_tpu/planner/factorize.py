@@ -3,9 +3,16 @@
 Rebuilds the reference planner's enumeration layer
 (``cost_model/GetWidth.h:7-47`` ``getWidth`` — DFS over divisors — and
 ``topo_count/factor_count.py`` — the search-space counter) without its
-global mutable accumulators (``GetWidth.h:7-8``, known-bug list SURVEY §8)
-and without the legacy 9-level-nested ``getWidth2`` (``GetWidth.h:51-227``,
-including its ``d[p]*d[q]`` typo at ``:198`` — deliberately not replicated).
+global mutable accumulators (``GetWidth.h:7-8``, known-bug list SURVEY §8).
+
+The reference's legacy second enumerator (``getWidth2``,
+``GetWidth.h:51-227``: candidates as products of prime-factor subsets, 9
+nested loop levels, and a ``d[p]*d[q]`` typo at ``:198`` that corrupts the
+last factor) is rebuilt here as
+:func:`ordered_factorizations_combinatoric` — the same combinatoric route
+(multiset factorizations from the prime decomposition, then distinct
+permutations), depth-unlimited and typo-free, cross-validated against the
+DFS enumerator in ``tests/test_planner.py``.
 
 Also provides primality / prime-factorization utilities
 (``cost_model/IsPrimeNumber.h``, ``GetPrimeFactor.h``), fixing the
@@ -16,10 +23,12 @@ from __future__ import annotations
 
 import functools
 
+
 __all__ = [
     "is_prime",
     "prime_factors",
     "ordered_factorizations",
+    "ordered_factorizations_combinatoric",
     "count_ordered_factorizations",
 ]
 
@@ -89,6 +98,69 @@ def ordered_factorizations(n: int, min_factor: int = 2) -> list[tuple[int, ...]]
 
     dfs(n, ())
     return out
+
+
+def ordered_factorizations_combinatoric(
+    n: int, min_factor: int = 2
+) -> list[tuple[int, ...]]:
+    """The P2 rebuild: the same candidate set as
+    :func:`ordered_factorizations`, derived the way the reference's legacy
+    ``getWidth2`` tried to (``GetWidth.h:51-227``) — *unordered* multiset
+    factorizations built from the prime decomposition, expanded into their
+    distinct orderings — rather than by divisor DFS.
+
+    Differences from the reference, on purpose: depth-unlimited (theirs
+    hardcoded 9 nested subset levels), no ``d[p]*d[q]`` typo
+    (``GetWidth.h:198`` draws the final factor from the wrong array,
+    corrupting candidates once >= 3 factor groups are in play), and no
+    flat/ring sentinel rows (``{1,N}``/``{N,1}``, ``:207-225``) — sentinel
+    handling lives in :class:`~flextree_tpu.schedule.stages.Topology`
+    parsing, not in the enumeration.  Returns a sorted list (deterministic,
+    unlike the reference's insertion order); equality with the DFS
+    enumerator is pinned by ``tests/test_planner.py``.
+    """
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    if n == 1:
+        return []
+
+    def multisets(rest: int, max_f: int) -> list[tuple[int, ...]]:
+        """Non-increasing factorizations of ``rest`` with factors
+        <= ``max_f`` (each a multiset of divisors >= min_factor)."""
+        out = []
+        if min_factor <= rest <= max_f:
+            out.append((rest,))
+        d = min(max_f, rest // min_factor)
+        while d >= min_factor:
+            if rest % d == 0:
+                for tail in multisets(rest // d, d):
+                    out.append((d,) + tail)
+            d -= 1
+        return out
+
+    def distinct_orderings(counts: dict[int, int], length: int):
+        """All distinct permutations of a factor multiset, generated
+        directly from its counts — multinomial cost, not the factorial
+        blow-up of ``itertools.permutations`` on repeated factors (at
+        n=4096 the (2,)*12 multiset has ONE ordering, not 12! duplicates
+        to dedup)."""
+        if length == 0:
+            yield ()
+            return
+        for f in counts:
+            if counts[f]:
+                counts[f] -= 1
+                for tail in distinct_orderings(counts, length - 1):
+                    yield (f,) + tail
+                counts[f] += 1
+
+    shapes: list[tuple[int, ...]] = []
+    for ms in multisets(n, n):
+        counts: dict[int, int] = {}
+        for f in ms:
+            counts[f] = counts.get(f, 0) + 1
+        shapes.extend(distinct_orderings(counts, len(ms)))
+    return sorted(shapes)
 
 
 @functools.lru_cache(maxsize=4096)

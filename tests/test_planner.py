@@ -74,6 +74,23 @@ class TestFactorize:
         assert count_ordered_factorizations(1) == 0
         assert count_ordered_factorizations(2) == 1
 
+    def test_combinatoric_enumerator_matches_dfs(self):
+        """P2 parity (GetWidth.h:51-227): the prime-multiset combinatoric
+        route must produce exactly the DFS enumerator's candidate set —
+        including n with >= 3 distinct primes, where the reference's
+        d[p]*d[q] typo (GetWidth.h:198) corrupts its last factor."""
+        from flextree_tpu.planner import ordered_factorizations_combinatoric
+
+        for n in list(range(1, 130)) + [360, 840, 2 * 3 * 5 * 7]:
+            assert ordered_factorizations_combinatoric(n) == sorted(
+                ordered_factorizations(n)
+            ), n
+        # deterministic sorted output, and edge cases mirror the DFS
+        assert ordered_factorizations_combinatoric(1) == []
+        assert ordered_factorizations_combinatoric(2) == [(2,)]
+        with pytest.raises(ValueError):
+            ordered_factorizations_combinatoric(0)
+
 
 # --------------------------------------------------------------- shapes ----
 
