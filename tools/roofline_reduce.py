@@ -175,6 +175,7 @@ def measure_point(
     length: int,
     dtype_name: str,
     rows_tile: int = 512,
+    sources_tile: int = 1,
     n_lo: int = 2,
     n_hi: int = 10,
     samples: int = 1,
@@ -210,7 +211,7 @@ def measure_point(
 
     def body_full(carry):
         out = reduce_stacked(carry, op="sum", rows_tile=rows_tile,
-                             interpret=False)
+                             sources_tile=sources_tile, interpret=False)
         return lax.dynamic_update_slice(carry, out[None] * 1e-3, (0, 0))
 
     t_full = time_device_loop(body_full, x, n_lo=n_lo, n_hi=n_hi,
@@ -256,6 +257,7 @@ def main() -> int:
           f"{xla_gbps:.0f} GB/s"
           + ("" if xla_isolated else "  [NOT chain-isolated]"))
     tiles = (256, 512, 1024) if args.sweep_tiles else (512,)
+    source_tiles = (1, 2, 4) if args.sweep_tiles else (1,)
     rows = []
     for w in (2, 4, 8):
         for dtype_name in ("float32", "bfloat16"):
@@ -263,27 +265,37 @@ def main() -> int:
             t_base = measure_base(x)
             best = None
             for rt in tiles:
-                dt, gbps, isolated = measure_point(
-                    w, args.length, dtype_name, rows_tile=rt, x=x,
-                    t_base=t_base,
-                )
-                if best is None or gbps > best[1]:
-                    best = (dt, gbps, rt, isolated)
-            dt, gbps, rt, isolated = best
+                for st in source_tiles:
+                    if w % st:
+                        continue  # gcd clamp would duplicate an st row
+                    dt, gbps, isolated = measure_point(
+                        w, args.length, dtype_name, rows_tile=rt,
+                        sources_tile=st, x=x, t_base=t_base,
+                    )
+                    if best is None or gbps > best[1]:
+                        best = (dt, gbps, rt, st, isolated)
+            dt, gbps, rt, st, isolated = best
             rows.append(
                 {
                     "w": w,
                     "dtype": dtype_name,
                     "length": args.length,
                     "rows_tile": rt,
+                    "sources_tile": st,
                     "per_call_ms": round(dt * 1e3, 3),
                     "achieved_GBps": round(gbps, 1),
                     "frac_of_peak": round(gbps / peak, 3) if peak else None,
+                    "frac_of_copy_ceiling": (
+                        round(gbps / copy_gbps, 3) if copy_gbps else None
+                    ),
                     "kernel_isolated": isolated,
                 }
             )
-            print(f"w={w} {dtype_name} (rows_tile={rt}): {gbps:.0f} GB/s"
+            print(f"w={w} {dtype_name} (rows_tile={rt}, sources_tile={st}): "
+                  f"{gbps:.0f} GB/s"
                   + (f" ({gbps / peak * 100:.0f}% of peak)" if peak else "")
+                  + (f" ({gbps / copy_gbps * 100:.0f}% of copy ceiling)"
+                     if copy_gbps else "")
                   + ("" if isolated else "  [NOT chain-isolated]"))
     from flextree_tpu.utils.buildstamp import artifact_meta
 
