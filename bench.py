@@ -83,7 +83,13 @@ def bench_tpu_kernel() -> dict:
 
     b, t, h, d = 4, 4096, 16, 128
     cfg = AttentionBenchConfig(batch=b, seq_len=t, heads=h, head_dim=d)
-    ours = autotune_attention(cfg)
+    # shortlisted blocks x both candidate forward schedules (r4): the
+    # winner ships, whatever it is
+    ours = autotune_attention(
+        cfg,
+        blocks=((256, 512), (512, 512), (1024, 512)),
+        variants=("pipelined", "kvgrid"),
+    )
 
     baseline_name = "stock_pallas_flash_tuned"
     try:
@@ -123,6 +129,7 @@ def bench_tpu_kernel() -> dict:
         "baseline": baseline_name,
         "baseline_tflops": round(base_tflops, 2),
         "blocks": [ours.config.block_q, ours.config.block_k],
+        "variant": ours.config.variant,
         "timing": "device_loop_slope",
     }
     peak = chip_peak_tflops()
@@ -175,7 +182,9 @@ def bench_cpu_allreduce() -> dict:
     }
 
 
-def bench_tpu_kernel_guarded(timeout_s: int = 1500) -> dict | None:
+def bench_tpu_kernel_guarded(timeout_s: int = 2400) -> dict | None:
+    # 2400s: r4's autotune sweeps 6 ours configs (3 blocks x 2 variants)
+    # + 2 stock, each ~2 slope-loop compiles over the tunnel
     """Run the TPU bench in a subprocess with a hard timeout.
 
     ``tpu_alive`` only proves the tunnel was up at probe time; it has been

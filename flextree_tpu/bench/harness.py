@@ -463,13 +463,15 @@ def autotune_attention(
     ),
     repeat: int | None = None,
     impl: str = "flash",
+    variants: tuple[str, ...] | None = None,
 ) -> AttentionBenchReport:
-    """Sweep explicit (block_q, block_k) pairs and return the fastest
-    report (VERDICT r1 item 3's autotune).  The default pairs are the top
-    configs from the v5e block sweep in PROFILE_ATTENTION.md — a compile
-    over the tunneled backend costs ~30 s, so the sweep is a shortlist,
-    not a product.  Works for ``impl="stock"`` too (block_k_major and the
-    backward blocks are derived in ``run_attention_bench``)."""
+    """Sweep explicit (block_q, block_k) pairs (x forward ``variants`` for
+    the flash impl) and return the fastest report (VERDICT r1 item 3's
+    autotune).  The default pairs are the top configs from the v5e block
+    sweep in PROFILE_ATTENTION.md — a compile over the tunneled backend
+    costs ~30 s, so the sweep is a shortlist, not a product.  Works for
+    ``impl="stock"`` too (block_k_major and the backward blocks are
+    derived in ``run_attention_bench``)."""
     rep_kw = {} if repeat is None else {"repeat": repeat}
     if impl == "reference":
         # block sizes don't reach attention_reference; sweeping them would
@@ -477,17 +479,28 @@ def autotune_attention(
         return run_attention_bench(
             dataclasses.replace(cfg, impl=impl, **rep_kw)
         )
+    if variants is None or impl != "flash":
+        variants = (cfg.variant,)
+    # fail fast on a bad variant name — the per-combo except below is for
+    # combos that don't FIT, and would otherwise silently drop the whole
+    # schedule from the sweep
+    unknown = set(variants) - {"loop", "pipelined", "kvgrid"}
+    if unknown:
+        raise ValueError(f"unknown flash variant(s): {sorted(unknown)}")
     best = None
-    for bq, bk in blocks:
-        c = dataclasses.replace(cfg, impl=impl, block_q=bq, block_k=bk,
-                                **rep_kw)
-        try:
-            r = run_attention_bench(c)
-        except Exception as e:  # noqa: BLE001 — a block combo may not fit
-            log.warning("autotune (%d, %d) failed: %s", bq, bk, e)
-            continue
-        if best is None or r.tflops > best.tflops:
-            best = r
+    for variant in variants:
+        for bq, bk in blocks:
+            c = dataclasses.replace(cfg, impl=impl, block_q=bq, block_k=bk,
+                                    variant=variant, **rep_kw)
+            try:
+                r = run_attention_bench(c)
+            except Exception as e:  # noqa: BLE001 — a combo may not fit
+                log.warning(
+                    "autotune (%s, %d, %d) failed: %s", variant, bq, bk, e
+                )
+                continue
+            if best is None or r.tflops > best.tflops:
+                best = r
     if best is None:
         raise RuntimeError("no autotune configuration succeeded")
     return best
