@@ -24,6 +24,7 @@ from .launch import (
 from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
 from .ring_attention import attention_reference, local_attention, ring_attention
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
+from .zigzag import zigzag_merge, zigzag_ring_attention, zigzag_split
 
 __all__ = [
     "allreduce",
@@ -46,6 +47,9 @@ __all__ = [
     "attention_reference",
     "local_attention",
     "ulysses_attention",
+    "zigzag_ring_attention",
+    "zigzag_split",
+    "zigzag_merge",
     "seq_to_heads",
     "heads_to_seq",
     "TrainConfig",

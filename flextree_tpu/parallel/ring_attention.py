@@ -171,14 +171,6 @@ def _ring_attention_flash(q, k, v, axis_name, *, causal: bool,
             jnp.full((b, t_local, h), _NEG_INF, jnp.float32),
         )
 
-    def merge(m, so, sd, out_j, lse_j):
-        m_new = jnp.maximum(m, lse_j)
-        c_old = jnp.exp(m - m_new)
-        c_new = jnp.exp(lse_j - m_new)
-        so = so * c_old[..., None] + out_j.astype(jnp.float32) * c_new[..., None]
-        sd = sd * c_old + c_new
-        return m_new, so, sd
-
     if n == 1:
         out, _ = (diag_hop if causal else full_hop)(k, v)
         return out
@@ -196,7 +188,7 @@ def _ring_attention_flash(q, k, v, axis_name, *, causal: bool,
             )
         else:
             out_j, lse_j = full_hop(k_blk, v_blk)
-        m, so, sd = merge(m, so, sd, out_j, lse_j)
+        m, so, sd = hop_merge((m, so, sd), out_j, lse_j)
         k_blk = lax.ppermute(k_blk, axis_name, right)
         v_blk = lax.ppermute(v_blk, axis_name, right)
         return (k_blk, v_blk, m, so, sd), None
@@ -208,7 +200,7 @@ def _ring_attention_flash(q, k, v, axis_name, *, causal: bool,
     (k, v, m, so, sd), _ = lax.scan(
         step, (k, v, m0, so0, sd0), jnp.arange(n)
     )
-    return _finalize(so, sd.transpose(0, 2, 1)).astype(q.dtype)
+    return hop_finalize((m, so, sd)).astype(q.dtype)
 
 
 def _finalize(acc, l):
@@ -216,6 +208,30 @@ def _finalize(acc, l):
     (possible only for non-causal edge cases) yield zeros, not NaNs."""
     denom = l.transpose(0, 2, 1)[..., None]
     return jnp.where(denom > 0, acc / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def hop_merge(carry, out_j, lse_j):
+    """Fold one hop's ``(out, lse)`` into the running ``(m, so, sd)``
+    accumulators — the per-hop analog of the per-element online softmax.
+    THE implementation: the flash ring and the zigzag ring both use it; a
+    numerics change here changes every ring schedule identically.
+    ``m``/``sd``: (B, Tq, H) running max / normalizer; ``so``: (B, Tq, H, D)
+    scaled weighted-value sum."""
+    m, so, sd = carry
+    m_new = jnp.maximum(m, lse_j)
+    c_old = jnp.exp(m - m_new)
+    c_new = jnp.exp(lse_j - m_new)
+    so = so * c_old[..., None] + out_j.astype(jnp.float32) * c_new[..., None]
+    sd = sd * c_old + c_new
+    return m_new, so, sd
+
+
+def hop_finalize(carry):
+    """Normalize merged hop accumulators; rows no hop touched (lse still at
+    the -inf sentinel, sd == 0) yield zeros, not NaNs."""
+    _, so, sd = carry
+    denom = sd[..., None]
+    return jnp.where(denom > 0, so / jnp.where(denom > 0, denom, 1.0), 0.0)
 
 
 def local_attention(q, k, v, *, causal: bool = True,

@@ -1,0 +1,238 @@
+"""Zigzag (load-balanced) causal ring attention.
+
+The plain causal ring (``ring_attention``) is SPMD-lockstep: at every hop
+some device still faces a fully-visible K/V block, so the ring's wall time
+is ~n full block-attentions even though half the score matrix is masked.
+The classic fix is the **zigzag layout**: split the global sequence into
+``2n`` chunks and give device ``i`` the pair ``(i, 2n-1-i)`` — one early
+chunk and one late chunk.  Under causal masking every device then owns the
+same visible work at every hop (one full chunk-pair: the early-vs-early
+and late-vs-late pairs trade visibility as the ring rotates, and the
+late-q-vs-early-k pair is always visible), so the ring finishes in
+roughly half the wall time at identical math.
+
+Everything here is collective-context (call inside ``shard_map`` with the
+sequence axis bound), like the rest of this package.  The layout
+converters move chunks with ``lax.ppermute`` (ICI neighbor DMAs — the same
+transport primitive as the ring itself; no all-gather, so per-device
+memory stays O(T/n)).  Chunk pairs are size-aligned, so each (q-chunk,
+kv-chunk) block is *exactly* one of future / diagonal / past — the same
+3-way ``lax.switch`` the flash ring uses (``_ring_attention_flash``),
+never a partially-shifted mask.
+
+Reference relation: the reference has no model layer (SURVEY §2.6); this
+extends the framework's sequence-parallel substrate (``ring_attention``,
+``ulysses``) with the balanced schedule long-context training actually
+uses.  The ring transport itself is the ``mpi_mod.hpp:1119-1147``
+decrementing block walk, unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["zigzag_split", "zigzag_merge", "zigzag_ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _owner(g: int, n: int) -> int:
+    """Zigzag owner of global chunk ``g``: device ``g`` for the early half,
+    device ``2n-1-g`` for the late half."""
+    return g if g < n else 2 * n - 1 - g
+
+
+def zigzag_split(x, axis_name):
+    """Contiguous sequence shards -> zigzag shards, via two ppermutes.
+
+    ``x``: (B, T_local, ...) with the global sequence = concatenation of
+    shards in axis-index order (device ``i`` holds chunks ``(2i, 2i+1)``);
+    T_local must be even.  Returns the same shape holding chunks
+    ``(idx, 2n-1-idx)``.  Both ppermutes are bijections: a device's two
+    chunks have opposite parity, and so do a zigzag owner's — each device
+    sends and receives exactly one chunk per permute.
+    """
+    n = lax.axis_size(axis_name)
+    t_local = x.shape[1]
+    if t_local % 2:
+        raise ValueError(f"zigzag needs an even local length, got {t_local}")
+    if n == 1:
+        return x
+    c = t_local // 2
+    idx = lax.axis_index(axis_name)
+    perm_even = [(i, _owner(2 * i, n)) for i in range(n)]
+    perm_odd = [(i, _owner(2 * i + 1, n)) for i in range(n)]
+    recv_even = lax.ppermute(x[:, :c], axis_name, perm_even)  # chunk 2*src
+    recv_odd = lax.ppermute(x[:, c:], axis_name, perm_odd)    # chunk 2*src+1
+    # this device's early chunk is g=idx (even iff idx is even); its late
+    # chunk 2n-1-idx has the opposite parity
+    early_is_even = idx % 2 == 0
+    early = jnp.where(early_is_even, recv_even, recv_odd)
+    late = jnp.where(early_is_even, recv_odd, recv_even)
+    return jnp.concatenate([early, late], axis=1)
+
+
+def zigzag_merge(x, axis_name):
+    """Inverse of :func:`zigzag_split` (zigzag shards -> contiguous).
+
+    Two parity-separated ppermute rounds: every device holds exactly one
+    even-numbered and one odd-numbered chunk, and every contiguous owner
+    ``i`` expects exactly one of each (``2i``, ``2i+1``) — both rounds are
+    bijections.
+    """
+    n = lax.axis_size(axis_name)
+    t_local = x.shape[1]
+    if t_local % 2:
+        raise ValueError(f"zigzag needs an even local length, got {t_local}")
+    if n == 1:
+        return x
+    c = t_local // 2
+    idx = lax.axis_index(axis_name)
+    early_is_even = idx % 2 == 0
+
+    # device j holds chunks g_early=j (slot 0) and g_late=2n-1-j (slot 1)
+    def even_chunk_of(j):
+        return j if j % 2 == 0 else 2 * n - 1 - j
+
+    def odd_chunk_of(j):
+        return j if j % 2 == 1 else 2 * n - 1 - j
+
+    perm_e = [(j, even_chunk_of(j) // 2) for j in range(n)]
+    perm_o = [(j, odd_chunk_of(j) // 2) for j in range(n)]
+    send_e = jnp.where(early_is_even, x[:, :c], x[:, c:])
+    send_o = jnp.where(early_is_even, x[:, c:], x[:, :c])
+    recv_e = lax.ppermute(send_e, axis_name, perm_e)  # lands as chunk 2i
+    recv_o = lax.ppermute(send_o, axis_name, perm_o)  # lands as chunk 2i+1
+    return jnp.concatenate([recv_e, recv_o], axis=1)
+
+
+def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
+                          layout: str = "contiguous", impl: str = "flash"):
+    """Causal exact attention, sequence-parallel, load-balanced.
+
+    ``q``/``k``/``v``: (B, T_local, H, D).  ``layout="contiguous"`` (the
+    trainer's natural sharding) converts in and out with
+    :func:`zigzag_split`/:func:`zigzag_merge`; ``layout="zigzag"`` expects
+    and returns zigzag shards (zero conversion cost — a model can stay in
+    zigzag layout end-to-end, since every other transformer op is
+    position-elementwise along the sequence).
+
+    Causal only — the balance argument is about the causal triangle; use
+    ``ring_attention`` for non-causal.  ``impl``: "flash" (fused Pallas
+    chunk kernels) or "reference" (jnp full-matrix chunk blocks — the CPU
+    oracle path).
+    """
+    from ..ops.pallas_attention import flash_attention
+    from .ring_attention import attention_reference, hop_finalize, hop_merge
+
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if impl not in ("flash", "reference"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    n = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if t_local % 2:
+        # validate here too: the zigzag-layout path never calls
+        # zigzag_split, and an odd length would otherwise die as a branch
+        # shape mismatch deep inside lax.switch
+        raise ValueError(f"zigzag needs an even local length, got {t_local}")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    local = (
+        (lambda q, k, v, causal: flash_attention(
+            q, k, v, causal=causal, scale=scale, return_lse=True))
+        if impl == "flash"
+        else (lambda q, k, v, causal: _reference_with_lse(
+            q, k, v, causal=causal, scale=scale))
+    )
+    if n == 1:
+        if impl == "flash":
+            return flash_attention(q, k, v, causal=True, scale=scale)
+        return attention_reference(q, k, v, causal=True, scale=scale)
+    if layout == "contiguous":
+        q, k, v = (zigzag_split(a, axis_name) for a in (q, k, v))
+    c = t_local // 2
+    idx = lax.axis_index(axis_name)
+
+    def full_hop(qb, kb, vb):
+        return local(qb, kb, vb, False)
+
+    def diag_hop(qb, kb, vb):
+        # chunk-aligned: equal global offsets cancel, offset-0 causal exact
+        return local(qb, kb, vb, True)
+
+    def masked_hop(qb, kb, vb):
+        return (
+            jnp.zeros_like(qb),
+            jnp.full((b, c, h), _NEG_INF, jnp.float32),
+        )
+
+    q_e, q_l = q[:, :c], q[:, c:]
+    right = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, s):
+        k_blk, v_blk, acc_e, acc_l = carry
+        src = (idx - s) % n
+        k_e, k_l = k_blk[:, :c], k_blk[:, c:]
+        v_e, v_l = v_blk[:, :c], v_blk[:, c:]
+        # visiting early chunk g=src vs our early chunk g=idx:
+        #   src == idx -> diagonal, src < idx -> past, src > idx -> future
+        br_e = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+        acc_e = hop_merge(
+            acc_e,
+            *lax.switch(br_e, [diag_hop, full_hop, masked_hop], q_e, k_e, v_e),
+        )
+        # visiting late chunk 2n-1-src vs our late chunk 2n-1-idx:
+        #   src == idx -> diagonal, src > idx -> past, src < idx -> future
+        br_l = jnp.where(src == idx, 0, jnp.where(src > idx, 1, 2))
+        acc_l = hop_merge(
+            acc_l,
+            *lax.switch(br_l, [diag_hop, full_hop, masked_hop], q_l, k_l, v_l),
+        )
+        # our late chunk always sees the visiting EARLY chunk (2n-1-idx >=
+        # n > src): statically full, no switch.  (Our early chunk never
+        # sees a late chunk: 2n-1-src >= n > idx — statically skipped.)
+        acc_l = hop_merge(acc_l, *full_hop(q_l, k_e, v_e))
+        k_blk = lax.ppermute(k_blk, axis_name, right)
+        v_blk = lax.ppermute(v_blk, axis_name, right)
+        return (k_blk, v_blk, acc_e, acc_l), None
+
+    def init_acc(qb):
+        zero_bth = (qb[..., 0] * 0).astype(jnp.float32)  # inherit vma axes
+        return (zero_bth + _NEG_INF, (qb * 0).astype(jnp.float32), zero_bth)
+
+    (k, v, acc_e, acc_l), _ = lax.scan(
+        step, (k, v, init_acc(q_e), init_acc(q_l)), jnp.arange(n)
+    )
+    out = jnp.concatenate(
+        [hop_finalize(acc_e), hop_finalize(acc_l)], axis=1
+    ).astype(q.dtype)
+    if layout == "contiguous":
+        out = zigzag_merge(out, axis_name)
+    return out
+
+
+def _reference_with_lse(q, k, v, *, causal: bool, scale: float):
+    """jnp chunk attention emitting (out, lse) — the oracle hop compute."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        pos = jnp.arange(q.shape[1])
+        mask = pos[:, None] >= pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = p.sum(axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = jnp.where(
+        l.transpose(0, 2, 1)[..., None] > 0,
+        out / jnp.maximum(l.transpose(0, 2, 1)[..., None], 1e-38),
+        0.0,
+    )
+    lse = jnp.where(
+        l > 0, m + jnp.log(jnp.maximum(l, 1e-38)), _NEG_INF
+    ).transpose(0, 2, 1)
+    return out.astype(q.dtype), lse

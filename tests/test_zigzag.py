@@ -1,0 +1,155 @@
+"""Zigzag (load-balanced) ring attention vs the single-device oracle.
+
+Same discipline as test_model_parallel's ring tests: every sharded
+computation is checked against an unsharded run of the same math
+(the reference's --comm-type A/B method, benchmark.cpp:147-174).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flextree_tpu.parallel.ring_attention import attention_reference
+from flextree_tpu.parallel.zigzag import (
+    zigzag_merge,
+    zigzag_ring_attention,
+    zigzag_split,
+)
+
+
+def _qkv(b=2, t=48, h=4, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((b, t, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+
+
+def _shard_fn(fn, sp, in_specs, out_specs):
+    mesh = jax.make_mesh((sp,), ("sp",))
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+    )
+
+
+# ---------------------------------------------------------------- layout
+
+
+@pytest.mark.parametrize("sp", [2, 3, 4, 8])
+def test_zigzag_split_places_chunk_pairs(sp):
+    """Device i must end up with global chunks (i, 2n-1-i)."""
+    t = 4 * sp  # 2 chunks of 2 per device
+    x = jnp.arange(t, dtype=jnp.float32).reshape(1, t, 1, 1)
+    split = _shard_fn(
+        lambda a: zigzag_split(a, "sp"), sp, (P(None, "sp"),), P(None, "sp")
+    )(x)
+    got = np.asarray(split).reshape(t)
+    c = t // (2 * sp)
+    expect = []
+    for i in range(sp):
+        expect.extend(range(i * c, (i + 1) * c))  # early chunk i
+        g = 2 * sp - 1 - i
+        expect.extend(range(g * c, (g + 1) * c))  # late chunk 2n-1-i
+    np.testing.assert_array_equal(got, np.asarray(expect, np.float32))
+
+
+@pytest.mark.parametrize("sp", [2, 3, 4, 8])
+def test_zigzag_roundtrip(sp):
+    q, _, _ = _qkv(t=8 * sp)
+    rt = _shard_fn(
+        lambda a: zigzag_merge(zigzag_split(a, "sp"), "sp"),
+        sp, (P(None, "sp"),), P(None, "sp"),
+    )(q)
+    np.testing.assert_array_equal(np.asarray(rt), np.asarray(q))
+
+
+def test_zigzag_rejects_odd_local_length():
+    with pytest.raises(ValueError, match="even"):
+        _shard_fn(
+            lambda a: zigzag_split(a, "sp"), 2, (P(None, "sp"),), P(None, "sp")
+        )(jnp.ones((1, 6, 1, 1)))  # 3 per device
+
+
+# ------------------------------------------------------------- attention
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_zigzag_attention_matches_reference(sp, layout):
+    q, k, v = _qkv(t=8 * sp)
+
+    def fn(q, k, v):
+        if layout == "zigzag":
+            q, k, v = (zigzag_split(a, "sp") for a in (q, k, v))
+        out = zigzag_ring_attention(
+            q, k, v, "sp", layout=layout, impl="reference"
+        )
+        if layout == "zigzag":
+            out = zigzag_merge(out, "sp")
+        return out
+
+    out = _shard_fn(fn, sp, (P(None, "sp"),) * 3, P(None, "sp"))(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_zigzag_flash_matches_reference_impl(sp):
+    q, k, v = _qkv(t=8 * sp)
+    out = _shard_fn(
+        lambda q, k, v: zigzag_ring_attention(q, k, v, "sp", impl="flash"),
+        sp, (P(None, "sp"),) * 3, P(None, "sp"),
+    )(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_zigzag_single_device_axis():
+    q, k, v = _qkv(t=16)
+    out = _shard_fn(
+        lambda q, k, v: zigzag_ring_attention(q, k, v, "sp", impl="reference"),
+        1, (P(None, "sp"),) * 3, P(None, "sp"),
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(attention_reference(q, k, v, causal=True)),
+        atol=1e-5,
+    )
+
+
+@pytest.mark.slow
+def test_zigzag_gradients_match_reference():
+    sp = 4
+    q, k, v = _qkv(t=8 * sp)
+    zig = _shard_fn(
+        lambda q, k, v: zigzag_ring_attention(q, k, v, "sp", impl="reference"),
+        sp, (P(None, "sp"),) * 3, P(None, "sp"),
+    )
+    g_zig = jax.jit(
+        jax.grad(lambda q, k, v: (zig(q, k, v) ** 2).sum(), argnums=(0, 1, 2))
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: (
+            attention_reference(q, k, v, causal=True) ** 2
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_zig, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_zigzag_rejects_bad_args():
+    q, k, v = _qkv(t=16)
+    with pytest.raises(ValueError, match="layout"):
+        _shard_fn(
+            lambda q, k, v: zigzag_ring_attention(q, k, v, "sp", layout="x"),
+            2, (P(None, "sp"),) * 3, P(None, "sp"),
+        )(q, k, v)
+    with pytest.raises(ValueError, match="impl"):
+        _shard_fn(
+            lambda q, k, v: zigzag_ring_attention(q, k, v, "sp", impl="x"),
+            2, (P(None, "sp"),) * 3, P(None, "sp"),
+        )(q, k, v)
