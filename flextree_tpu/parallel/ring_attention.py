@@ -250,12 +250,19 @@ def local_attention(q, k, v, *, causal: bool = True,
 
 
 def attention_reference(q, k, v, *, causal: bool = True,
-                        scale: float | None = None):
+                        scale: float | None = None,
+                        return_lse: bool = False):
     """Single-device full-matrix attention — the oracle for ring attention.
 
     Same semantics on unsharded (B, T, H, D) inputs; used by the tests the
     way ``--comm-type mpi`` served as the reference's A/B oracle
     (``benchmark.cpp:147-174``).
+
+    ``return_lse=True`` additionally returns the per-row logsumexp of the
+    masked scores, (B, T, H) float32 with fully-masked rows at the -1e30
+    sentinel — the same contract as ``flash_attention(return_lse=True)``,
+    so blockwise consumers (the zigzag ring) can use either as the hop
+    compute.
     """
     b, t, h, d = q.shape
     if scale is None:
@@ -265,6 +272,19 @@ def attention_reference(q, k, v, *, causal: bool = True,
         pos = jnp.arange(t)
         mask = pos[:, None] >= pos[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+    if not return_lse:
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = p.sum(axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    denom = l.transpose(0, 2, 1)[..., None]
+    out = jnp.where(denom > 0, out / jnp.where(denom > 0, denom, 1.0), 0.0)
+    lse = jnp.where(
+        l > 0, m + jnp.log(jnp.maximum(l, 1e-38)), _NEG_INF
+    ).transpose(0, 2, 1)
+    return out.astype(q.dtype), lse

@@ -133,26 +133,31 @@ def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
         raise ValueError(f"unknown attention impl {impl!r}")
     n = lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
-    if t_local % 2:
-        # validate here too: the zigzag-layout path never calls
-        # zigzag_split, and an odd length would otherwise die as a branch
-        # shape mismatch deep inside lax.switch
-        raise ValueError(f"zigzag needs an even local length, got {t_local}")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     local = (
         (lambda q, k, v, causal: flash_attention(
             q, k, v, causal=causal, scale=scale, return_lse=True))
         if impl == "flash"
-        else (lambda q, k, v, causal: _reference_with_lse(
-            q, k, v, causal=causal, scale=scale))
+        else (lambda q, k, v, causal: attention_reference(
+            q, k, v, causal=causal, scale=scale, return_lse=True))
     )
     if n == 1:
+        # no split at n=1 — odd local lengths are fine here
         if impl == "flash":
             return flash_attention(q, k, v, causal=True, scale=scale)
         return attention_reference(q, k, v, causal=True, scale=scale)
+    if t_local % 2:
+        # validate on the zigzag-layout path too (it never calls
+        # zigzag_split); an odd length would otherwise die as a branch
+        # shape mismatch deep inside lax.switch
+        raise ValueError(f"zigzag needs an even local length, got {t_local}")
     if layout == "contiguous":
-        q, k, v = (zigzag_split(a, axis_name) for a in (q, k, v))
+        # one split for all three tensors: batch-concatenate so the layout
+        # exchange is 2 ppermutes moving 3x payload, not 6 latency-bound
+        # launches per attention call
+        qkv = zigzag_split(jnp.concatenate([q, k, v], axis=0), axis_name)
+        q, k, v = qkv[:b], qkv[b:2 * b], qkv[2 * b:]
     c = t_local // 2
     idx = lax.axis_index(axis_name)
 
@@ -212,27 +217,3 @@ def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
     if layout == "contiguous":
         out = zigzag_merge(out, axis_name)
     return out
-
-
-def _reference_with_lse(q, k, v, *, causal: bool, scale: float):
-    """jnp chunk attention emitting (out, lse) — the oracle hop compute."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        pos = jnp.arange(q.shape[1])
-        mask = pos[:, None] >= pos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    m = s.max(axis=-1)
-    p = jnp.exp(s - m[..., None])
-    if causal:
-        p = jnp.where(mask[None, None], p, 0.0)
-    l = p.sum(axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    out = jnp.where(
-        l.transpose(0, 2, 1)[..., None] > 0,
-        out / jnp.maximum(l.transpose(0, 2, 1)[..., None], 1e-38),
-        0.0,
-    )
-    lse = jnp.where(
-        l > 0, m + jnp.log(jnp.maximum(l, 1e-38)), _NEG_INF
-    ).transpose(0, 2, 1)
-    return out.astype(q.dtype), lse

@@ -76,7 +76,7 @@ def test_zigzag_rejects_odd_local_length():
 # ------------------------------------------------------------- attention
 
 
-@pytest.mark.parametrize("sp", [2, 4, 8])
+@pytest.mark.parametrize("sp", [2, 3, 4, 8])
 @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
 def test_zigzag_attention_matches_reference(sp, layout):
     q, k, v = _qkv(t=8 * sp)
@@ -105,6 +105,20 @@ def test_zigzag_flash_matches_reference_impl(sp):
     )(q, k, v)
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_zigzag_single_device_axis_odd_length():
+    """n == 1 takes the plain-attention path, so odd lengths are fine."""
+    q, k, v = _qkv(t=15)
+    out = _shard_fn(
+        lambda q, k, v: zigzag_ring_attention(q, k, v, "sp", impl="reference"),
+        1, (P(None, "sp"),) * 3, P(None, "sp"),
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(attention_reference(q, k, v, causal=True)),
+        atol=1e-5,
+    )
 
 
 def test_zigzag_single_device_axis():
@@ -139,6 +153,67 @@ def test_zigzag_gradients_match_reference():
     )(q, k, v)
     for a, b in zip(g_zig, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+# ------------------------------------------------------------- model switch
+
+
+def test_forward_zigzag_matches_single_device():
+    from flextree_tpu.models.transformer import (
+        TransformerConfig,
+        forward,
+        init_params,
+        param_specs,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        sp_impl="zigzag",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    ref = forward(params, tokens, cfg)
+
+    mesh = jax.make_mesh((4, 2), ("sp", "tp"))
+    fn = jax.jit(
+        jax.shard_map(
+            lambda p, tok: forward(p, tok, cfg, tp_axis="tp", sp_axis="sp"),
+            mesh=mesh,
+            in_specs=(param_specs(cfg, "tp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+            check_vma=False,
+        )
+    )
+    out = fn(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+@pytest.mark.slow
+def test_train_step_zigzag_matches_single_device():
+    from flextree_tpu.models.transformer import TransformerConfig
+    from flextree_tpu.parallel.train import (
+        init_train_state,
+        make_mesh_3d,
+        make_train_step,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        sp_impl="zigzag",
+    )
+    state = init_train_state(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+    targets = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+    s8, m8 = make_train_step(make_mesh_3d(8, (2, 2, 2)), cfg)(state, tokens, targets)
+    s1, m1 = make_train_step(make_mesh_3d(1, (1, 1, 1)), cfg)(state, tokens, targets)
+    np.testing.assert_allclose(float(m8["loss"]), float(m1["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(s8["params"])),
+        jax.tree.leaves(jax.device_get(s1["params"])),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
 def test_zigzag_rejects_bad_args():
