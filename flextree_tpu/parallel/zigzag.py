@@ -108,6 +108,17 @@ def zigzag_merge(x, axis_name):
     return jnp.concatenate([recv_e, recv_o], axis=1)
 
 
+def hop_branches(src, idx):
+    """Visibility branch selection for one hop, shared by the kernel and
+    the balance test: for the visiting source ``src`` and this device
+    ``idx``, returns ``(br_early, br_late)`` with 0=diagonal, 1=past
+    (full), 2=future (masked) — the early pair compares chunk ``src`` vs
+    ``idx``, the late pair ``2n-1-src`` vs ``2n-1-idx`` (order flips)."""
+    br_e = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+    br_l = jnp.where(src == idx, 0, jnp.where(src > idx, 1, 2))
+    return br_e, br_l
+
+
 def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
                           layout: str = "contiguous", impl: str = "flash"):
     """Causal exact attention, sequence-parallel, load-balanced.
@@ -182,16 +193,13 @@ def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
         src = (idx - s) % n
         k_e, k_l = k_blk[:, :c], k_blk[:, c:]
         v_e, v_l = v_blk[:, :c], v_blk[:, c:]
-        # visiting early chunk g=src vs our early chunk g=idx:
-        #   src == idx -> diagonal, src < idx -> past, src > idx -> future
-        br_e = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+        # visiting early chunk g=src vs our early chunk g=idx; late chunk
+        # 2n-1-src vs our late 2n-1-idx (comparison flips) — hop_branches
+        br_e, br_l = hop_branches(src, idx)
         acc_e = hop_merge(
             acc_e,
             *lax.switch(br_e, [diag_hop, full_hop, masked_hop], q_e, k_e, v_e),
         )
-        # visiting late chunk 2n-1-src vs our late chunk 2n-1-idx:
-        #   src == idx -> diagonal, src > idx -> past, src < idx -> future
-        br_l = jnp.where(src == idx, 0, jnp.where(src > idx, 1, 2))
         acc_l = hop_merge(
             acc_l,
             *lax.switch(br_l, [diag_hop, full_hop, masked_hop], q_l, k_l, v_l),
