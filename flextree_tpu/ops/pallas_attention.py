@@ -431,8 +431,10 @@ def _flash_fwd_impl(
         # for the pipeline to double-buffer, with per-minor-tile compute
         # skip keeping the causal diagonal cheap
         n_minor = tk_pad // bk
+        # default 1: the 2048 cap bounds the UPSIZING only — a single
+        # larger-than-2048 minor tile (big block_k) still runs unchanged
         u = next(
-            u for u in (4, 2, 1) if n_minor % u == 0 and bk * u <= 2048
+            (u for u in (4, 2, 1) if n_minor % u == 0 and bk * u <= 2048), 1
         )
         bkM = bk * u
         res = pl.pallas_call(

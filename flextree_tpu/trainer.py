@@ -117,7 +117,9 @@ def main(argv=None) -> int:
     ap.add_argument("--top-k", type=int, default=2)
     ap.add_argument("--capacity-factor", type=float, default=2.0)
     ap.add_argument("--microbatches", type=int, default=2)
-    ap.add_argument("--sp-impl", choices=["ring", "ulysses"], default="ring")
+    ap.add_argument(
+        "--sp-impl", choices=["ring", "zigzag", "ulysses"], default="ring"
+    )
     ap.add_argument("--attn-impl", choices=["reference", "flash"],
                     default="reference")
     ap.add_argument("--lr", type=float, default=1e-3)

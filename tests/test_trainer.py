@@ -189,6 +189,19 @@ def test_trainer_cli_dense(capsys):
     assert "dense: 4 steps" in out
 
 
+def test_trainer_cli_zigzag_sp(capsys):
+    from flextree_tpu.trainer import main
+
+    rc = main([
+        "--steps", "2", "--log-every", "1", "--batch", "8",
+        "--seq-len", "32", "--d-model", "32", "--d-ff", "64",
+        "--sp-impl", "zigzag", "--mesh", "2,2,2",
+        "--corpus-tokens", "20000",
+    ])
+    assert rc == 0
+    assert "dense: 2 steps" in capsys.readouterr().out
+
+
 def test_trainer_cli_moe(capsys):
     from flextree_tpu.trainer import main
 
