@@ -61,6 +61,9 @@ __all__ = [
     "resolve_axis_topos",
     "sync_grads",
     "adamw_apply",
+    "schedule_lr",
+    "global_grad_norm",
+    "clip_by_global_norm",
 ]
 
 # Lazy (PEP 562): .train/.pipeline import ..models.transformer, which
@@ -76,6 +79,9 @@ _TRAIN_EXPORTS = (
     "resolve_axis_topos",
     "sync_grads",
     "adamw_apply",
+    "schedule_lr",
+    "global_grad_norm",
+    "clip_by_global_norm",
 )
 
 _PIPELINE_EXPORTS = (

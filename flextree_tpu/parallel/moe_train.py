@@ -26,6 +26,8 @@ from .pipeline import factor_devices_4d, make_mesh_4d
 from .train import (
     TrainConfig,
     adamw_apply,
+    maybe_clip_grads,
+    metric_specs,
     make_state_specs,
     make_train_state,
     resolve_axis_topos,
@@ -134,19 +136,21 @@ def make_moe_train_step(
             global_ce = lax.psum(global_ce, ax)
             global_aux = lax.psum(global_aux, ax)
 
-        new_state = adamw_apply(state, grads, train_cfg)
         metrics = {
             "loss": global_ce,
             "aux": global_aux,
             "total": global_ce + model_cfg.router_aux_weight * global_aux,
         }
+        grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
+        new_state = adamw_apply(state, grads, train_cfg)
         return new_state, metrics
 
+    mspec = metric_specs(train_cfg, {"loss": P(), "aux": P(), "total": P()})
     sharded = jax.shard_map(
         device_step,
         mesh=mesh,
         in_specs=(sspecs, data_spec, data_spec),
-        out_specs=(sspecs, {"loss": P(), "aux": P(), "total": P()}),
+        out_specs=(sspecs, mspec),
         check_vma=False,
     )
     return jax.jit(sharded)

@@ -50,6 +50,8 @@ from ..models.transformer import (
 from .train import (
     TrainConfig,
     adamw_apply,
+    maybe_clip_grads,
+    metric_specs,
     make_mesh_nd,
     make_state_specs,
     make_train_state,
@@ -277,14 +279,17 @@ def make_pipeline_train_step(
         for ax in mesh_axes:
             global_loss = lax.psum(global_loss, ax)
 
+        metrics = {"loss": global_loss}
+        grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
         new_state = adamw_apply(state, grads, train_cfg)
-        return new_state, {"loss": global_loss}
+        return new_state, metrics
 
+    mspec = metric_specs(train_cfg, {"loss": P()})
     sharded = jax.shard_map(
         device_step,
         mesh=mesh,
         in_specs=(sspecs, data_spec, data_spec),
-        out_specs=(sspecs, {"loss": P()}),
+        out_specs=(sspecs, mspec),
         check_vma=False,
     )
     return jax.jit(sharded)

@@ -62,6 +62,11 @@ __all__ = [
     "resolve_axis_topos",
     "sync_grads",
     "adamw_apply",
+    "schedule_lr",
+    "global_grad_norm",
+    "clip_by_global_norm",
+    "maybe_clip_grads",
+    "metric_specs",
 ]
 
 
@@ -78,6 +83,17 @@ class TrainConfig:
     # "psum" selects the native XLA all-reduce instead of FlexTree — the
     # A/B oracle (and escape hatch) inside the production train step.
     grad_topo: Any = None
+    # global-norm gradient clipping (0 = off).  The norm is the TRUE global
+    # norm: tp-sharded leaves psum their shard's square-sum over the tp
+    # axis before the total (see global_grad_norm).
+    grad_clip_norm: float = 0.0
+    # learning-rate schedule: "constant", or "warmup_cosine" (linear ramp
+    # over warmup_steps, cosine decay to min_lr_frac*lr at total_steps —
+    # total_steps required then)
+    schedule: str = "constant"
+    warmup_steps: int = 0
+    total_steps: int = 0
+    min_lr_frac: float = 0.1
 
 
 def prime_factors(n: int) -> list[int]:
@@ -228,12 +244,103 @@ def sync_grads(grads, pspecs, mesh_axes, topos: dict):
     return jax.tree.map(sync, grads, pspecs, is_leaf=lambda x: x is None)
 
 
+def schedule_lr(train_cfg: "TrainConfig", step):
+    """Learning rate at (1-based) ``step`` under the config's schedule.
+
+    "constant": ``lr``.  "warmup_cosine": linear 0 -> lr over
+    ``warmup_steps``, then cosine from lr down to ``min_lr_frac * lr`` at
+    ``total_steps`` (flat at the floor beyond).  Pure jnp on a traced
+    step, so it lives inside the jitted train step.
+    """
+    if train_cfg.schedule == "constant":
+        return jnp.float32(train_cfg.lr)
+    if train_cfg.schedule != "warmup_cosine":
+        raise ValueError(f"unknown schedule {train_cfg.schedule!r}")
+    if train_cfg.total_steps <= 0:
+        raise ValueError("schedule='warmup_cosine' needs total_steps > 0")
+    t = step.astype(jnp.float32) if hasattr(step, "astype") else jnp.float32(step)
+    warm = jnp.float32(max(train_cfg.warmup_steps, 1))
+    ramp = jnp.minimum(t / warm, 1.0)
+    span = jnp.float32(max(train_cfg.total_steps - train_cfg.warmup_steps, 1))
+    frac = jnp.clip((t - warm) / span, 0.0, 1.0)
+    floor = jnp.float32(train_cfg.min_lr_frac)
+    cos = floor + (1.0 - floor) * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+    return jnp.float32(train_cfg.lr) * ramp * jnp.where(t <= warm, 1.0, cos)
+
+
+def global_grad_norm(grads, pspecs):
+    """True global L2 norm of a sharded gradient tree.
+
+    A leaf holds only this device's shard along every mesh axis its
+    PartitionSpec names — its square-sum psums over exactly those axes
+    before joining the total; axes NOT in the spec see the leaf
+    replicated, where a psum would overcount by the axis size.  (After
+    ``sync_grads``, gradients are replicated across data axes, which
+    never appear in param specs — so the rule is uniform across the
+    dense, pipeline, and MoE steps: tp-column shards, pp stage stacks,
+    and ep expert shards all sum once each.)  Leaves are grouped by
+    their axis-set and each group's local total psums ONCE per set
+    (psum is linear) — 2-3 scalar collectives per step, not one per leaf.
+    """
+    flat_g, treedef = jax.tree.flatten(grads)
+    flat_s = treedef.flatten_up_to(pspecs)
+    by_axes: dict[tuple, Any] = {}
+    for g, spec in zip(flat_g, flat_s):
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        names: set = set()
+        for entry in tuple(spec) if spec is not None else ():
+            if entry is None:
+                continue
+            names.update(entry if isinstance(entry, tuple) else (entry,))
+        key = tuple(sorted(names))
+        by_axes[key] = by_axes.get(key, jnp.float32(0.0)) + sq
+    total = jnp.float32(0.0)
+    for axes, sq in by_axes.items():
+        for axis in axes:
+            sq = lax.psum(sq, axis)
+        total = total + sq
+    return jnp.sqrt(total)
+
+
+def clip_by_global_norm(grads, norm, clip: float):
+    """Scale the tree so its global norm is at most ``clip`` (> 0)."""
+    if clip <= 0:
+        raise ValueError(f"clip must be positive, got {clip}")
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+
+
+def maybe_clip_grads(grads, pspecs, train_cfg: "TrainConfig", metrics: dict):
+    """Shared clip-and-record step for every train-step builder: when
+    ``grad_clip_norm`` is set (must be positive), clips ``grads`` to it
+    and records the pre-clip norm in ``metrics['grad_norm']``."""
+    if not train_cfg.grad_clip_norm:
+        return grads
+    if train_cfg.grad_clip_norm < 0:
+        raise ValueError(
+            f"grad_clip_norm must be positive, got {train_cfg.grad_clip_norm}"
+        )
+    norm = global_grad_norm(grads, pspecs)
+    metrics["grad_norm"] = norm
+    return clip_by_global_norm(grads, norm, train_cfg.grad_clip_norm)
+
+
+def metric_specs(train_cfg: "TrainConfig", base: dict) -> dict:
+    """Out-specs for a step's metrics dict: ``base`` plus the clip norm
+    when clipping is on — must mirror :func:`maybe_clip_grads`."""
+    out = dict(base)
+    if train_cfg.grad_clip_norm:
+        out["grad_norm"] = P()
+    return out
+
+
 def adamw_apply(state: dict, grads, train_cfg: "TrainConfig") -> dict:
     """One AdamW update on (sharded) state; moments shard like the params."""
     step = state["step"] + 1
     t = step.astype(jnp.float32)
     c1 = 1.0 - train_cfg.b1**t
     c2 = 1.0 - train_cfg.b2**t
+    lr = schedule_lr(train_cfg, step)
 
     def upd(p, g, mu, nu):
         mu = train_cfg.b1 * mu + (1.0 - train_cfg.b1) * g
@@ -241,7 +348,7 @@ def adamw_apply(state: dict, grads, train_cfg: "TrainConfig") -> dict:
         delta = (mu / c1) / (jnp.sqrt(nu / c2) + train_cfg.eps)
         if train_cfg.weight_decay:
             delta = delta + train_cfg.weight_decay * p
-        return p - train_cfg.lr * delta, mu, nu
+        return p - lr * delta, mu, nu
 
     flat_p, treedef = jax.tree.flatten(state["params"])
     flat_g = treedef.flatten_up_to(grads)
@@ -299,14 +406,17 @@ def make_train_step(
         grads = sync_grads(grads, sspecs["params"], mesh_axes, topos)
         global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
 
+        metrics = {"loss": global_loss}
+        grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
         new_state = adamw_apply(state, grads, train_cfg)
-        return new_state, {"loss": global_loss}
+        return new_state, metrics
 
+    mspec = metric_specs(train_cfg, {"loss": P()})
     sharded = jax.shard_map(
         device_step,
         mesh=mesh,
         in_specs=(sspecs, data_spec, data_spec),
-        out_specs=(sspecs, {"loss": P()}),
+        out_specs=(sspecs, mspec),
         check_vma=False,
     )
     return jax.jit(sharded)

@@ -27,7 +27,15 @@ def build(args):
     from .models.transformer import TransformerConfig
     from .parallel.train import TrainConfig
 
-    tc = TrainConfig(lr=args.lr, grad_topo=args.grad_topo)
+    tc = TrainConfig(
+        lr=args.lr,
+        grad_topo=args.grad_topo,
+        grad_clip_norm=args.grad_clip,
+        schedule=args.schedule,
+        warmup_steps=args.warmup_steps,
+        total_steps=args.steps if args.schedule == "warmup_cosine" else 0,
+        min_lr_frac=args.min_lr_frac,
+    )
     key = jax.random.PRNGKey(args.seed)
     mesh_shape = (
         tuple(int(x) for x in args.mesh.split(",")) if args.mesh else None
@@ -123,6 +131,22 @@ def main(argv=None) -> int:
     ap.add_argument("--attn-impl", choices=["reference", "flash"],
                     default="reference")
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument(
+        "--grad-clip", type=float, default=0.0,
+        help="global-norm gradient clipping (0 = off); the norm psums "
+        "tp-sharded leaves so it is the TRUE global norm",
+    )
+    ap.add_argument(
+        "--schedule", choices=["constant", "warmup_cosine"],
+        default="constant",
+        help="warmup_cosine ramps over --warmup-steps then decays to "
+        "min_lr_frac*lr at --steps",
+    )
+    ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument(
+        "--min-lr-frac", type=float, default=0.1,
+        help="cosine floor as a fraction of --lr (warmup_cosine only)",
+    )
     ap.add_argument("--grad-topo", type=str, default=None,
                     help="FT_TOPO-style widths for the gradient allreduce")
     ap.add_argument("--mesh", type=str, default=None,
