@@ -54,7 +54,7 @@ def load_native(build_if_missing: bool = True):
         lib = ctypes.CDLL(str(lib_path))
     except OSError:
         return None
-    if not hasattr(lib, "ft_choose2"):
+    if not hasattr(lib, "ft_enumerate_shapes2"):
         # stale library built from an older source tree (the marker symbol
         # is the NEWEST entry point — bump it whenever the ABI grows, or a
         # prebuilt .so silently lacks the new path).
@@ -74,7 +74,7 @@ def load_native(build_if_missing: bool = True):
             lib = ctypes.CDLL(tmp.name)
         except OSError:
             return None
-        if not hasattr(lib, "ft_choose2"):
+        if not hasattr(lib, "ft_enumerate_shapes2"):
             return None
 
     lib.ft_count_shapes.restype = ctypes.c_uint64
@@ -102,6 +102,8 @@ def load_native(build_if_missing: bool = True):
     ]
     lib.ft_sweep.restype = ctypes.c_uint64
     lib.ft_sweep.argtypes = [ctypes.c_uint64] + [ctypes.c_double] * 6
+    lib.ft_enumerate_shapes2.restype = ctypes.c_int64
+    lib.ft_enumerate_shapes2.argtypes = list(lib.ft_enumerate_shapes.argtypes)
     lib.ft_choose2.restype = ctypes.c_int32
     lib.ft_choose2.argtypes = [
         ctypes.c_uint64,
@@ -135,14 +137,11 @@ def native_count_shapes(n: int) -> int | None:
     return int(lib.ft_count_shapes(n))
 
 
-def native_enumerate_shapes(n: int) -> list[tuple[int, ...]] | None:
-    lib = load_native()
-    if lib is None:
-        return None
+def _read_shape_records(fn, n: int) -> list[tuple[int, ...]] | None:
     needed = ctypes.c_uint64(0)
-    lib.ft_enumerate_shapes(n, None, 0, ctypes.byref(needed))
+    fn(n, None, 0, ctypes.byref(needed))
     buf = (ctypes.c_uint32 * max(1, needed.value))()
-    cnt = lib.ft_enumerate_shapes(n, buf, needed.value, ctypes.byref(needed))
+    cnt = fn(n, buf, needed.value, ctypes.byref(needed))
     if cnt < 0:
         return None
     out, off = [], 0
@@ -151,6 +150,25 @@ def native_enumerate_shapes(n: int) -> list[tuple[int, ...]] | None:
         out.append(tuple(buf[off + 1 : off + 1 + k]))
         off += 1 + k
     return out
+
+
+def native_enumerate_shapes(n: int) -> list[tuple[int, ...]] | None:
+    lib = load_native()
+    if lib is None:
+        return None
+    return _read_shape_records(lib.ft_enumerate_shapes, n)
+
+
+def native_enumerate_shapes_combinatoric(n: int) -> list[tuple[int, ...]] | None:
+    """The native P2 twin (``ft_enumerate_shapes2``): candidates via
+    prime-multiset factorizations + distinct orderings, sorted — the
+    reference's legacy ``getWidth2`` route, typo-free (GetWidth.h:198).
+    None when the library is unavailable (an older build without the
+    symbol triggers load_native's marker-driven rebuild)."""
+    lib = load_native()
+    if lib is None:
+        return None
+    return _read_shape_records(lib.ft_enumerate_shapes2, n)
 
 
 def native_shape_cost(

@@ -97,6 +97,69 @@ double ring_cost(uint64_t n, const CostParams& p, double nbytes) {
   return lat + bw + red;
 }
 
+// The P2 rebuild, native twin (the reference's legacy getWidth2 was C++,
+// GetWidth.h:51-227): candidates via *unordered* multiset factorizations
+// from the divisor lattice, expanded into distinct orderings by counts
+// recursion — same output set as enumerate_shapes, different algorithm.
+// Depth-unlimited (theirs hardcoded 9 subset levels) and without the
+// d[p]*d[q] last-factor typo (GetWidth.h:198).
+void multisets_rec(uint64_t rest, uint64_t max_f, std::vector<uint32_t>& ms,
+                   std::vector<std::vector<uint32_t>>& out) {
+  if (rest >= 2 && rest <= max_f) {
+    ms.push_back(static_cast<uint32_t>(rest));
+    out.push_back(ms);
+    ms.pop_back();
+  }
+  uint64_t d = std::min(max_f, rest / 2);
+  for (; d >= 2; --d) {
+    if (rest % d == 0) {
+      ms.push_back(static_cast<uint32_t>(d));
+      multisets_rec(rest / d, d, ms, out);
+      ms.pop_back();
+    }
+  }
+}
+
+void orderings_rec(std::vector<std::pair<uint32_t, uint32_t>>& counts,
+                   uint32_t remaining, std::vector<uint32_t>& prefix,
+                   std::vector<std::vector<uint32_t>>& out) {
+  if (remaining == 0) {
+    out.push_back(prefix);
+    return;
+  }
+  for (auto& fc : counts) {
+    if (fc.second == 0) continue;
+    --fc.second;
+    prefix.push_back(fc.first);
+    orderings_rec(counts, remaining - 1, prefix, out);
+    prefix.pop_back();
+    ++fc.second;
+  }
+}
+
+std::vector<std::vector<uint32_t>> enumerate_shapes_combinatoric(uint64_t n) {
+  std::vector<std::vector<uint32_t>> shapes;
+  if (n < 2) return shapes;
+  std::vector<std::vector<uint32_t>> multisets;
+  std::vector<uint32_t> ms;
+  multisets_rec(n, n, ms, multisets);
+  for (auto& m : multisets) {
+    // m is non-increasing; build (factor, count) pairs
+    std::vector<std::pair<uint32_t, uint32_t>> counts;
+    for (uint32_t f : m) {
+      if (!counts.empty() && counts.back().first == f) {
+        ++counts.back().second;
+      } else {
+        counts.push_back({f, 1});
+      }
+    }
+    std::vector<uint32_t> prefix;
+    orderings_rec(counts, static_cast<uint32_t>(m.size()), prefix, shapes);
+  }
+  std::sort(shapes.begin(), shapes.end());
+  return shapes;
+}
+
 }  // namespace
 
 extern "C" {
@@ -125,12 +188,13 @@ uint64_t ft_count_shapes(uint64_t n) {
   return total;
 }
 
-// Enumerate shapes into `buf` as [k, w0, .., w_{k-1}] records.
-// Returns the number of shapes; sets *needed to the required uint32 count.
-// If buf_len is insufficient, writes nothing beyond buf_len and returns -1.
-int64_t ft_enumerate_shapes(uint64_t n, uint32_t* buf, uint64_t buf_len,
+// Pack shapes into `buf` as [k, w0, .., w_{k-1}] records (shared by both
+// enumerators).  Returns the number of shapes; sets *needed to the
+// required uint32 count; if buf_len is insufficient, writes nothing and
+// returns -1.
+static int64_t pack_records(const std::vector<std::vector<uint32_t>>& shapes,
+                            uint32_t* buf, uint64_t buf_len,
                             uint64_t* needed) {
-  auto shapes = enumerate_shapes(n);
   uint64_t req = 0;
   for (const auto& s : shapes) req += 1 + s.size();
   if (needed) *needed = req;
@@ -142,6 +206,22 @@ int64_t ft_enumerate_shapes(uint64_t n, uint32_t* buf, uint64_t buf_len,
     off += s.size();
   }
   return static_cast<int64_t>(shapes.size());
+}
+
+// Enumerate shapes into `buf` (record format/contract: see pack_records).
+int64_t ft_enumerate_shapes(uint64_t n, uint32_t* buf, uint64_t buf_len,
+                            uint64_t* needed) {
+  return pack_records(enumerate_shapes(n), buf, buf_len, needed);
+}
+
+// The combinatoric enumerator (P2 twin), same record format as
+// ft_enumerate_shapes but sorted lexicographically; cross-validated
+// against both the DFS enumerator and the Python twin in
+// tests/test_planner.py::TestNative::test_combinatoric_enumeration_parity.
+// NOTE: newest ABI entry point — load_native's stale-library marker.
+int64_t ft_enumerate_shapes2(uint64_t n, uint32_t* buf, uint64_t buf_len,
+                             uint64_t* needed) {
+  return pack_records(enumerate_shapes_combinatoric(n), buf, buf_len, needed);
 }
 
 // Cost of a single shape (widths of length k; pass k=1,widths={1} for ring).

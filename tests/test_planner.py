@@ -240,6 +240,20 @@ class TestNative:
         for n in [2, 8, 12, 60, 97]:
             assert set(native_enumerate_shapes(n)) == set(ordered_factorizations(n))
 
+    def test_combinatoric_enumeration_parity(self):
+        """Native P2 twin (ft_enumerate_shapes2): three independent
+        enumerators — native combinatoric, Python combinatoric, native
+        DFS — must agree exactly (the reference's getWidth2, typo-free)."""
+        from flextree_tpu.planner import ordered_factorizations_combinatoric
+        from flextree_tpu.planner.native import (
+            native_enumerate_shapes_combinatoric,
+        )
+
+        for n in [1, 2, 8, 12, 60, 97, 360, 840]:
+            nat = native_enumerate_shapes_combinatoric(n)
+            assert nat == ordered_factorizations_combinatoric(n), n
+            assert nat == sorted(native_enumerate_shapes(n)), n
+
     def test_cost_parity(self):
         params = TpuCostParams()
         for n, widths in [(16, (4, 4)), (16, (2, 2, 2, 2)), (8, (8,)), (8, (1,))]:
