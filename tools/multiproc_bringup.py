@@ -46,8 +46,9 @@ NUM_PROCESSES = 2
 LOCAL_DEVICES = 4
 
 
-def child_main(port: int) -> int:
-    """One process of the 2-process world (invoked with --child)."""
+def child_main() -> int:
+    """One process of the 2-process world (invoked with --child); the
+    coordinator address arrives via the FT_* launcher env triple."""
     import jax
 
     # CPU pinning must precede any backend touch; gloo is the CPU
@@ -212,7 +213,7 @@ def main() -> int:
     ap.add_argument("--no-artifact", action="store_true")
     args = ap.parse_args()
     if args.child:
-        return child_main(args.port)
+        return child_main()
     return spawn(args.port, None if args.no_artifact else args.out)
 
 
