@@ -1,0 +1,86 @@
+"""Lowering verification for zigzag ring attention: the compiled program
+contains exactly the collectives the balanced schedule assumes.
+
+Companion to ``test_hlo_lowering.py`` (which pins the allreduce stages):
+the zigzag claim is about *schedule structure*, so the structure is pinned
+at the StableHLO level — the layout exchange is a fixed number of
+``collective_permute`` ops (ppermute bijections), the ring walk is a
+scan-carried pair of k/v permutes, and nothing lowers to ``all_to_all``
+or ``all_gather`` (which would mean the O(T/n) memory contract broke).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flextree_tpu.parallel.zigzag import (
+    zigzag_merge,
+    zigzag_ring_attention,
+    zigzag_split,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _count(ir: str, op: str) -> int:
+    return len(re.findall(rf'"stablehlo.{op}"', ir))
+
+
+def _lower(fn, *shapes):
+    mesh = jax.make_mesh((8,), ("sp",))
+    return (
+        jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=(P(None, "sp"),) * len(shapes),
+                out_specs=P(None, "sp"), check_vma=False,
+            )
+        )
+        .lower(*(jnp.zeros(s, jnp.float32) for s in shapes))
+        .as_text()
+    )
+
+
+def test_split_and_merge_are_two_permutes_each():
+    ir = _lower(lambda x: zigzag_split(x, "sp"), (1, 64, 2, 8))
+    assert _count(ir, "collective_permute") == 2
+    assert _count(ir, "all_to_all") == 0
+    ir = _lower(lambda x: zigzag_merge(x, "sp"), (1, 64, 2, 8))
+    assert _count(ir, "collective_permute") == 2
+
+
+def test_zigzag_attention_collective_budget():
+    """Contiguous-layout attention: one batched q/k/v split (2 permutes),
+    the scan's k/v ring hops (2 in the loop body), and the output merge
+    (2) — and no all_to_all or all_gather anywhere, so the per-device
+    working set stays O(T/n)."""
+    ir = _lower(
+        lambda q, k, v: zigzag_ring_attention(
+            q, k, v, "sp", impl="reference"
+        ),
+        (1, 64, 2, 8), (1, 64, 2, 8), (1, 64, 2, 8),
+    )
+    # 2 (qkv split) + 2 (k/v hops inside the while body) + 2 (out merge)
+    assert _count(ir, "collective_permute") == 6, _count(
+        ir, "collective_permute"
+    )
+    assert _count(ir, "all_to_all") == 0
+    assert _count(ir, "all_gather") == 0
+
+
+def test_zigzag_layout_mode_adds_no_conversion_collectives():
+    """layout='zigzag' must lower to ONLY the scan's 2 ring hops — the
+    zero-conversion-cost claim of the end-to-end zigzag layout."""
+    ir = _lower(
+        lambda q, k, v: zigzag_ring_attention(
+            q, k, v, "sp", layout="zigzag", impl="reference"
+        ),
+        (1, 64, 2, 8), (1, 64, 2, 8), (1, 64, 2, 8),
+    )
+    assert _count(ir, "collective_permute") == 2
+    assert _count(ir, "all_to_all") == 0
+    assert _count(ir, "all_gather") == 0

@@ -158,8 +158,7 @@ def spawn(port: int, out_path: str | None) -> int:
         env = {**env_base, "FT_PROCESS_ID": str(pid)}
         procs.append(
             subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__), "--child",
-                 "--port", str(port)],
+                [sys.executable, os.path.abspath(__file__), "--child"],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
