@@ -88,7 +88,7 @@ def bench_tpu_kernel() -> dict:
     ours = autotune_attention(
         cfg,
         blocks=((256, 512), (512, 512), (1024, 512)),
-        variants=("pipelined", "kvgrid"),
+        variants=("loop", "pipelined", "kvgrid"),
     )
 
     baseline_name = "stock_pallas_flash_tuned"
@@ -135,6 +135,76 @@ def bench_tpu_kernel() -> dict:
     peak = chip_peak_tflops()
     if peak:
         out["mfu"] = round(ours.tflops / peak, 4)
+    try:  # end-to-end flagship forward MFU (VERDICT r4 item 8)
+        out.update(bench_model_forward(ours.config))
+    except Exception as e:  # supplementary row — never sink the main metric
+        out["model_fwd_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
+def bench_model_forward(attn_cfg=None) -> dict:
+    """Single-chip flagship-model forward MFU, device-loop slope timed.
+
+    The kernel A/B above isolates the hot op; this row answers the
+    end-to-end question — what fraction of the chip's bf16 peak the whole
+    transformer forward (embed + L x (qkvo/flash-attention/mlp) + logits)
+    sustains.  The loop chains greedy-sampled tokens back into the next
+    forward (same (B, T) int32 shape/dtype), so every iteration is
+    data-dependent and the slope cancels tunnel dispatch latency, exactly
+    like the kernel rows.  FLOPs are the analytic matmul+attention count
+    (causal attention at T_eff = T/2), the standard MFU convention.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flextree_tpu.bench.harness import chip_peak_tflops
+    from flextree_tpu.models.transformer import (
+        TransformerConfig,
+        forward,
+        init_params,
+    )
+    from flextree_tpu.utils.timing import time_device_loop
+
+    b, t = 2, 4096
+    # run the autotune winner's kernel config inside the model, not the
+    # library defaults — attn_cfg is the AttentionBenchConfig that won
+    attn_opts = ()
+    if attn_cfg is not None:
+        attn_opts = (
+            ("block_q", attn_cfg.block_q),
+            ("block_k", attn_cfg.block_k),
+            ("variant", attn_cfg.variant),
+        )
+    cfg = TransformerConfig(
+        vocab_size=32768,
+        d_model=2048,
+        n_heads=16,  # head_dim 128: the flash kernel's native lane width
+        n_layers=4,
+        d_ff=8192,
+        dtype=jnp.bfloat16,
+        attn_impl="flash",
+        attn_opts=attn_opts,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, cfg.vocab_size)
+
+    def step(toks, params):
+        logits = forward(params, toks, cfg)
+        return jnp.argmax(logits, axis=-1).astype(toks.dtype)
+
+    sec = time_device_loop(step, tokens, params, n_lo=1, n_hi=5)
+    d, dff, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    per_token = cfg.n_layers * (8 * d * d + 4 * d * dff + 2 * t * d) + 2 * d * v
+    tflops = per_token * b * t / sec / 1e12
+    out = {
+        "model_fwd_tflops": round(tflops, 2),
+        "model_fwd_config": f"d{d}_ff{dff}_L{cfg.n_layers}_h{cfg.n_heads}"
+        f"_b{b}_t{t}_v{v}_bf16_flash",
+        "model_fwd_attn_opts": dict(attn_opts) or "library defaults",
+    }
+    peak = chip_peak_tflops()
+    if peak:
+        out["model_fwd_mfu"] = round(tflops / peak, 4)
     return out
 
 
@@ -182,9 +252,10 @@ def bench_cpu_allreduce() -> dict:
     }
 
 
-def bench_tpu_kernel_guarded(timeout_s: int = 2400) -> dict | None:
-    # 2400s: r4's autotune sweeps 6 ours configs (3 blocks x 2 variants)
-    # + 2 stock, each ~2 slope-loop compiles over the tunnel
+def bench_tpu_kernel_guarded(timeout_s: int = 3300) -> dict | None:
+    # 3300s: r5's autotune sweeps 9 ours configs (3 blocks x 3 variants)
+    # + 2 stock, each ~2 slope-loop compiles over the tunnel, plus the
+    # 4-layer model-forward MFU row (2 more, larger, compiles)
     """Run the TPU bench in a subprocess with a hard timeout.
 
     ``tpu_alive`` only proves the tunnel was up at probe time; it has been

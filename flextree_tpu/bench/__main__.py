@@ -59,7 +59,7 @@ def main(argv=None) -> int:
     ap.add_argument("--block-k", type=int, default=512)
     ap.add_argument(
         "--attn-variant", choices=["loop", "pipelined", "kvgrid"],
-        default="pipelined",
+        default="loop",
         help="flash forward k-walk structure (ablation knob for the "
         "MXU/VPU-overlap win; loop = the carry-serialized r03 kernel)",
     )

@@ -244,7 +244,7 @@ class AttentionBenchConfig:
     block_k: int = 512
     # forward k-walk structure (flash impl only): "loop" | "pipelined" |
     # "kvgrid" — see flextree_tpu.ops.pallas_attention.flash_attention
-    variant: str = "pipelined"
+    variant: str = "loop"
     # "device_loop": in-jit chained fori_loop, slope of two iteration
     # counts — measures DEVICE time only, immune to the tunneled backend's
     # per-dispatch latency (the r01/r02 numbers were dominated by it; see

@@ -70,7 +70,8 @@ class TransformerConfig:
     tp_topo: Any = None
     # sequence-parallel attention strategy: "ring" (K/V walk the ring,
     # heads unconstrained), "zigzag" (the ring with the load-balanced
-    # chunk-pair layout — ~2x throughput for causal; even local length),
+    # chunk-pair layout — critical path 2-1/n of plain causal ring's,
+    # see ZIGZAG_ACCOUNTING.json; even local length),
     # or "ulysses" (two all-to-alls, needs the local head count divisible
     # by the sp axis size)
     sp_impl: str = "ring"
@@ -78,6 +79,12 @@ class TransformerConfig:
     # (fused Pallas kernel, ops.pallas_attention) — applies wherever the
     # full sequence is local (no sp axis, or the Ulysses inner attention)
     attn_impl: str = "reference"
+    # extra kwargs for the flash kernel on the full-sequence-local path
+    # (block_q / block_k / variant), as a hashable tuple of (key, value)
+    # pairs so the frozen config stays usable as a jit static — e.g.
+    # (("block_q", 1024), ("variant", "kvgrid")) to run the autotuned
+    # winner instead of library defaults
+    attn_opts: tuple = ()
 
     @property
     def head_dim(self) -> int:
@@ -191,7 +198,10 @@ def attention_block(
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     if sp_axis is None:
-        attn = local_attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        attn = local_attention(
+            q, k, v, causal=True, impl=cfg.attn_impl,
+            **(dict(cfg.attn_opts) if cfg.attn_impl == "flash" else {}),
+        )
     elif cfg.sp_impl == "ulysses":
         attn = ulysses_attention(q, k, v, sp_axis, causal=True, impl=cfg.attn_impl)
     elif cfg.sp_impl == "ring":

