@@ -236,14 +236,25 @@ def bench_cpu_allreduce() -> dict:
         # degenerate NNLS fit (measurements too noisy to be consistent with
         # the model): fall back to the default constants rather than dying
         plan = choose_topology(8, size * 4)
-    ours = run_allreduce_bench(
-        BenchConfig(
-            size=size, repeat=10, comm_type="flextree", topo=plan.to_ft_topo()
-        )
+    # best-of-2 runs per side, INTERLEAVED (ours, base, ours, base): the
+    # headline is min-of-reps, and on this timeshared 1-core host a single
+    # run's min swings enough to move vs_baseline ~20% round-to-round
+    # (r03 1.478 vs r04 1.203 came from a slow psum BASELINE run, not from
+    # our collective changing).  Interleaving bounds a sustained host-
+    # contention episode to at most one (ours, base) pair; back-to-back
+    # pairs would let one episode inflate both reps of a side.
+    ours_cfg = BenchConfig(
+        size=size, repeat=10, comm_type="flextree", topo=plan.to_ft_topo()
     )
-    base = run_allreduce_bench(BenchConfig(size=size, repeat=10, comm_type="xla"))
-    if not ours.correct or not base.correct:
+    base_cfg = BenchConfig(size=size, repeat=10, comm_type="xla")
+    ours_reps, base_reps = [], []
+    for _ in range(2):
+        ours_reps.append(run_allreduce_bench(ours_cfg))
+        base_reps.append(run_allreduce_bench(base_cfg))
+    if not all(r.correct for r in ours_reps + base_reps):
         raise RuntimeError("correctness check failed in bench")
+    ours = max(ours_reps, key=lambda r: r.bus_bw_GBps)
+    base = max(base_reps, key=lambda r: r.bus_bw_GBps)
     return {
         "metric": "allreduce_bus_bw_8vdev_cpu",
         "value": round(ours.bus_bw_GBps, 3),
