@@ -212,7 +212,9 @@ def bench_cpu_allreduce() -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from flextree_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(8)
     import numpy as np
     import jax.numpy as jnp
 

@@ -1,10 +1,31 @@
 """Execution backends for FlexTree schedules.
 
-- ``simulator``: single-process NumPy oracle (message-granular, clamped tails).
+- ``simulator``: single-process NumPy oracle (message-granular, clamped
+  tails) — also the chaos oracle: a ``FaultPlan`` injects transport faults
+  and rank kills, and the mailbox detects or recovers every one (see
+  docs/FAILURE_MODEL.md).
 - ``xla``: the real TPU path — schedules lowered to XLA collectives under
   ``shard_map`` (see ``flextree_tpu.parallel``).
 """
 
-from .simulator import simulate_allreduce, simulate_ring_allreduce, simulate_tree_allreduce
+from .simulator import (
+    Fault,
+    FaultDetected,
+    FaultEvent,
+    FaultPlan,
+    ScheduleViolation,
+    simulate_allreduce,
+    simulate_ring_allreduce,
+    simulate_tree_allreduce,
+)
 
-__all__ = ["simulate_allreduce", "simulate_ring_allreduce", "simulate_tree_allreduce"]
+__all__ = [
+    "simulate_allreduce",
+    "simulate_ring_allreduce",
+    "simulate_tree_allreduce",
+    "Fault",
+    "FaultPlan",
+    "FaultEvent",
+    "FaultDetected",
+    "ScheduleViolation",
+]

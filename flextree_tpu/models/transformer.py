@@ -191,6 +191,14 @@ def attention_block(
     output combined through the FlexTree allreduce."""
     b, t_local, _ = x.shape
     head_dim = cfg.head_dim
+    attn_opts = dict(cfg.attn_opts)
+    if attn_opts and cfg.attn_impl != "flash":
+        # a tuned config silently running with library defaults is exactly
+        # the artifact-comparison hazard ADVICE r5 flagged — fail loudly
+        raise ValueError(
+            f"attn_opts {sorted(attn_opts)} require attn_impl='flash', "
+            f"got {cfg.attn_impl!r}"
+        )
     h = rms_norm(x, layer["ln1"])
     q = (h @ layer["wq"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
     k = (h @ layer["wk"].astype(cfg.dtype)).reshape(b, t_local, -1, head_dim)
@@ -199,11 +207,22 @@ def attention_block(
     k = apply_rope(k, positions, cfg.rope_theta)
     if sp_axis is None:
         attn = local_attention(
-            q, k, v, causal=True, impl=cfg.attn_impl,
-            **(dict(cfg.attn_opts) if cfg.attn_impl == "flash" else {}),
+            q, k, v, causal=True, impl=cfg.attn_impl, **attn_opts
         )
     elif cfg.sp_impl == "ulysses":
-        attn = ulysses_attention(q, k, v, sp_axis, causal=True, impl=cfg.attn_impl)
+        # Ulysses' inner attention is also full-sequence-local flash —
+        # the tuned opts apply there too (ADVICE r5)
+        attn = ulysses_attention(
+            q, k, v, sp_axis, causal=True, impl=cfg.attn_impl, **attn_opts
+        )
+    elif attn_opts:
+        # ring/zigzag hop kernels run library defaults; a tuned config
+        # that cannot be honored must fail, not silently degrade
+        raise ValueError(
+            f"attn_opts {sorted(attn_opts)} are not supported by "
+            f"sp_impl={cfg.sp_impl!r} (only the full-sequence-local and "
+            f"ulysses paths take flash kwargs)"
+        )
     elif cfg.sp_impl == "ring":
         attn = ring_attention(q, k, v, sp_axis, causal=True, impl=cfg.attn_impl)
     elif cfg.sp_impl == "zigzag":

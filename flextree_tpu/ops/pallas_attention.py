@@ -184,9 +184,14 @@ def _flash_kernel(
         )
         carry = (m, l, acc)
     else:
-        carry = lax.fori_loop(
-            0, kb_full, step_full, (m0, l0, acc0), unroll=unroll
-        )
+        try:
+            carry = lax.fori_loop(
+                0, kb_full, step_full, (m0, l0, acc0), unroll=unroll
+            )
+        except ValueError:
+            # older JAX rejects unroll with the dynamic (causal) bound;
+            # unroll is a tuning knob, never a semantics change — fall back
+            carry = lax.fori_loop(0, kb_full, step_full, (m0, l0, acc0))
     m, l, acc = lax.fori_loop(kb_full, kb_hi, step_masked, carry)
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     o_ref[0] = out.astype(o_ref.dtype)

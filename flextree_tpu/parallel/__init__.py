@@ -13,11 +13,16 @@ from .allreduce import (
     tree_allreduce,
 )
 from .launch import (
+    BringupConfigError,
+    BringupError,
+    BringupReport,
+    BringupTimeout,
     ClusterConfig,
     dcn_axis_names,
     flatten_mesh,
     hybrid_mesh,
     init_distributed,
+    init_distributed_or_degrade,
     plan_for_mesh,
     topology_for_hybrid,
 )
@@ -38,6 +43,11 @@ __all__ = [
     "topology_from_mesh",
     "ClusterConfig",
     "init_distributed",
+    "init_distributed_or_degrade",
+    "BringupError",
+    "BringupConfigError",
+    "BringupTimeout",
+    "BringupReport",
     "hybrid_mesh",
     "flatten_mesh",
     "dcn_axis_names",

@@ -26,7 +26,9 @@ from __future__ import annotations
 import json
 import math
 import os
-from dataclasses import dataclass
+import random
+import time
+from dataclasses import dataclass, field
 from pathlib import Path
 
 import numpy as np
@@ -39,12 +41,60 @@ from ..schedule.stages import Topology
 __all__ = [
     "ClusterConfig",
     "init_distributed",
+    "init_distributed_or_degrade",
+    "BringupError",
+    "BringupConfigError",
+    "BringupTimeout",
+    "BringupReport",
     "hybrid_mesh",
     "flatten_mesh",
     "dcn_axis_names",
     "plan_for_mesh",
     "topology_for_hybrid",
+    "FT_INIT_TIMEOUT_ENV",
+    "FT_INIT_RETRIES_ENV",
 ]
+
+# env knobs for the bring-up retry wrapper (documented in
+# docs/FAILURE_MODEL.md): overall deadline in seconds, and how many times
+# a failed jax.distributed.initialize is retried within it
+FT_INIT_TIMEOUT_ENV = "FT_INIT_TIMEOUT"
+FT_INIT_RETRIES_ENV = "FT_INIT_RETRIES"
+
+# injection points for the tests (patch these, not time.*)
+_sleep = time.sleep
+_monotonic = time.monotonic
+
+
+class BringupError(RuntimeError):
+    """Base of the launch-failure taxonomy."""
+
+
+class BringupConfigError(BringupError):
+    """The cluster config itself is invalid — retrying cannot help."""
+
+
+class BringupTimeout(BringupError):
+    """The world did not assemble before the deadline/retry budget.
+
+    Carries ``attempts`` and the per-attempt error strings so the caller
+    (or the chaos harness) can see *why* each attempt failed.
+    """
+
+    def __init__(self, msg: str, attempts: int, errors: list[str]):
+        super().__init__(msg)
+        self.attempts = attempts
+        self.errors = errors
+
+
+@dataclass
+class BringupReport:
+    """What the retry wrapper did to get the runtime up."""
+
+    attempts: int = 0
+    elapsed_s: float = 0.0
+    errors: list = field(default_factory=list)  # one string per failed attempt
+    degraded_to: int | None = None  # survivor world size, when degraded
 
 
 # --------------------------------------------------------------------------
@@ -98,8 +148,73 @@ class ClusterConfig:
         )
 
 
-def init_distributed(config: ClusterConfig | str | Path | None = None) -> None:
-    """Bring up the multi-host runtime (idempotent).
+def _resolve_config(config, merge_env: bool = True) -> ClusterConfig:
+    """The cluster-config handshake: file/object + env overrides.
+
+    Raises :class:`BringupConfigError` for malformed configs (never worth
+    retrying) and lets transient file errors (launcher still writing the
+    shared file) propagate as-is so the retry loop can wait them out.
+    ``merge_env=False`` skips the env overlay — the degrade path re-forms
+    the world with a *different* process count than the launcher's
+    ``FT_NUM_PROCESSES`` and must not have it stomped back.
+    """
+    if isinstance(config, ClusterConfig):
+        cfg = config
+    elif config is not None:
+        try:
+            cfg = ClusterConfig.from_file(config)
+        except json.JSONDecodeError:
+            raise  # possibly mid-write by the launcher: transient, retryable
+        except (ValueError, TypeError) as e:  # malformed keys/types
+            raise BringupConfigError(f"bad cluster config {config}: {e}") from e
+    else:
+        cfg = ClusterConfig()
+    return cfg.merged(ClusterConfig.from_env()) if merge_env else cfg
+
+
+def _probe_coordinator(coordinator: str, budget_s: float) -> None:
+    """Bounded TCP reachability check of the coordinator's port.
+
+    On the pinned JAX, a deadline exceeded *inside* the
+    ``jax.distributed.initialize`` handshake hard-aborts the process (the
+    XLA coordination client ``LOG(FATAL)``s when the RegisterTask RPC
+    misses its deadline) — a non-coordinator process therefore must not
+    enter the handshake until the coordinator is actually listening.  This
+    probe is where the retryable wait happens: it raises a catchable
+    :class:`ConnectionError` after ``budget_s`` seconds so the retry loop
+    can back off and try again.
+    """
+    import socket
+
+    host, _, port = coordinator.rpartition(":")
+    deadline = _monotonic() + budget_s
+    last: Exception | None = None
+    while True:
+        try:
+            with socket.create_connection(
+                (host or "localhost", int(port)), timeout=min(budget_s, 2.0)
+            ):
+                return
+        except OSError as e:
+            last = e
+        if _monotonic() >= deadline:
+            raise ConnectionError(
+                f"coordinator {coordinator} unreachable for {budget_s:.0f}s "
+                f"({last})"
+            )
+        _sleep(0.25)
+
+
+def init_distributed(
+    config: ClusterConfig | str | Path | None = None,
+    *,
+    timeout: float | None = None,
+    retries: int | None = None,
+    backoff: float = 0.5,
+    max_backoff: float = 8.0,
+    merge_env: bool = True,
+) -> BringupReport:
+    """Bring up the multi-host runtime (idempotent), with retry/backoff.
 
     ``config``: a :class:`ClusterConfig`, a path to its JSON file, or None.
     Env vars (``FT_*``) override file values, mirroring how the reference's
@@ -107,24 +222,187 @@ def init_distributed(config: ClusterConfig | str | Path | None = None) -> None:
     fields may be None — ``jax.distributed.initialize`` auto-detects.  No-op
     when already initialized or when the world is one process with no
     coordinator configured (the single-host dev loop).
+
+    Failure handling (the reference's answer to a flaky coordinator port
+    was an opaque ``mpirun`` hang; ours is a taxonomy): ``timeout`` is the
+    *per-attempt* handshake deadline in seconds (env ``FT_INIT_TIMEOUT``;
+    forwarded as ``initialization_timeout``, so an absent coordinator
+    turns into a raised error instead of a 300 s default wait),
+    ``retries`` is how many failed attempts to retry (env
+    ``FT_INIT_RETRIES``, default 2), spaced by exponential backoff with
+    jitter starting at ``backoff`` seconds — worst-case wall clock is
+    bounded by ``(retries+1)*timeout + sum(backoffs)``.  Malformed configs
+    raise :class:`BringupConfigError` immediately; an exhausted budget
+    raises :class:`BringupTimeout` carrying every attempt's error.
+    Returns a :class:`BringupReport` on success.
     """
+    report = BringupReport()
     if _distributed_client_active():
-        return  # already initialized by us or the runtime
-    cfg = (
-        config
-        if isinstance(config, ClusterConfig)
-        else ClusterConfig.from_file(config)
-        if config is not None
-        else ClusterConfig()
-    )
-    cfg = cfg.merged(ClusterConfig.from_env())
-    if cfg.coordinator is None and cfg.num_processes in (None, 1):
-        return  # single-process run: nothing to initialize
-    jax.distributed.initialize(
-        coordinator_address=cfg.coordinator,
-        num_processes=cfg.num_processes,
-        process_id=cfg.process_id,
-    )
+        return report  # already initialized by us or the runtime
+    if timeout is None:
+        env_t = os.environ.get(FT_INIT_TIMEOUT_ENV)
+        timeout = float(env_t) if env_t else None
+    if retries is None:
+        env_r = os.environ.get(FT_INIT_RETRIES_ENV)
+        retries = int(env_r) if env_r else 2
+    t_start = _monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        report.attempts = attempt
+        try:
+            cfg = _resolve_config(config, merge_env=merge_env)
+            if cfg.coordinator is None and cfg.num_processes in (None, 1):
+                return report  # single-process run: nothing to initialize
+            if (
+                timeout is not None
+                and cfg.coordinator
+                and cfg.process_id not in (None, 0)
+            ):
+                # with a handshake deadline configured, wait for the
+                # coordinator OUTSIDE initialize: a deadline inside the
+                # handshake kills the process on this JAX pin (see
+                # _probe_coordinator), while a probe failure is retryable
+                _probe_coordinator(cfg.coordinator, timeout)
+            kw = {}
+            if timeout is not None:
+                kw["initialization_timeout"] = max(1, int(timeout))
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+                **kw,
+            )
+            report.elapsed_s = _monotonic() - t_start
+            return report
+        except BringupConfigError:
+            raise
+        except Exception as e:  # transient: connect refused, timeout, ...
+            report.errors.append(f"{type(e).__name__}: {e}")
+            _reset_partial_bringup()
+            if attempt > retries:
+                report.elapsed_s = _monotonic() - t_start
+                raise BringupTimeout(
+                    f"distributed bring-up failed after {attempt} attempt(s) "
+                    f"in {report.elapsed_s:.1f}s; last error: {e}",
+                    attempt,
+                    report.errors,
+                ) from e
+            delay = min(backoff * (2 ** (attempt - 1)), max_backoff)
+            delay *= 0.5 + random.random() / 2  # jitter: avoid retry stampede
+            _sleep(delay)
+
+
+def _reset_partial_bringup() -> None:
+    """Clear half-initialized ``jax.distributed`` state after a failed
+    connect: ``initialize`` assigns ``global_state.client`` (and, on
+    process 0, ``.service``) *before* the handshake succeeds, and a second
+    call raises "should only be called once" unless they are torn down.
+    """
+    try:
+        from jax._src import distributed
+
+        st = distributed.global_state
+        for attr in ("client", "service", "preemption_sync_manager"):
+            obj = getattr(st, attr, None)
+            if obj is not None:
+                try:
+                    obj.shutdown()
+                except Exception:
+                    pass
+            setattr(st, attr, None)
+    except Exception:  # pragma: no cover - private-API drift
+        pass
+
+
+def init_distributed_or_degrade(
+    config: ClusterConfig | str | Path | None = None,
+    *,
+    nbytes: int,
+    survivors=None,
+    min_processes: int = 1,
+    timeout: float | None = None,
+    retries: int | None = None,
+):
+    """Bring up the configured world, or degrade to the survivors.
+
+    The degrade-to-survivors path (docs/FAILURE_MODEL.md §replanning): the
+    *launcher* — the only party that knows which processes are alive —
+    supplies ``survivors`` (an int, or a callable returning one, e.g. a
+    probe of its child processes).  When it reports fewer processes than
+    configured, the world is formed with ``num_processes = survivors``
+    directly, and the allreduce topology is replanned for the surviving
+    count via ``flextree_tpu.planner.replan_for_survivors`` (awkward
+    survivor counts fall back to lonely topologies or the ring, so a
+    7-of-8 world still gets a real tree).
+
+    The degrade decision is taken *before* attempting the full-world
+    barrier when the liveness source already reports a short world: on the
+    pinned JAX, a coordinator whose peers never register is hard-aborted
+    by the XLA coordination client when the handshake deadline passes
+    (``LOG(FATAL)``, not a raisable error), so discovering the shortfall
+    by timing out in-process is not survivable.  If the full attempt does
+    fail catchably (:class:`BringupTimeout`), the liveness source is
+    re-polled and the same degrade applies.  The launcher remains
+    responsible for re-assigning contiguous ``process_id``s when the dead
+    process was not the highest-numbered one.
+
+    Returns ``(report, plan)``: ``plan`` is None when the full world came
+    up, else the replanned :class:`~flextree_tpu.planner.choose.Plan` for
+    the degraded world (``report.degraded_to`` names its size).
+    """
+    try:
+        cfg = _resolve_config(config)
+    except json.JSONDecodeError:
+        # launcher still writing the shared file: transient — skip the
+        # upfront liveness decision and let init_distributed's retry loop
+        # wait the file out (it re-resolves on every attempt)
+        cfg = None
+
+    def _alive():
+        return survivors() if callable(survivors) else survivors
+
+    def _short(n_alive):
+        configured = cfg.num_processes if cfg is not None else None
+        return (
+            n_alive is not None
+            and configured is not None
+            and min_processes <= n_alive < configured
+        )
+
+    def _degrade(n_alive, prior_attempts=0, prior_errors=()):
+        from ..planner.choose import replan_for_survivors
+
+        configured = cfg.num_processes
+        degraded = ClusterConfig(
+            coordinator=cfg.coordinator,
+            num_processes=n_alive,
+            process_id=cfg.process_id,
+        )
+        report = init_distributed(
+            degraded, timeout=timeout, retries=retries, merge_env=False
+        )
+        report.attempts += prior_attempts
+        report.errors = list(prior_errors) + report.errors
+        report.degraded_to = n_alive
+        plan = replan_for_survivors(n_alive, nbytes, configured=configured)
+        return report, plan
+
+    n_alive = _alive()
+    if _short(n_alive):
+        return _degrade(n_alive)
+    try:
+        return init_distributed(config, timeout=timeout, retries=retries), None
+    except BringupTimeout as full_err:
+        if cfg is None:
+            try:  # the full attempt's retries may have outlived the mid-write
+                cfg = _resolve_config(config)
+            except json.JSONDecodeError:
+                raise full_err from None
+        n_alive = _alive()  # re-poll: the world may have shrunk while waiting
+        if not _short(n_alive):
+            raise
+        return _degrade(n_alive, full_err.attempts, full_err.errors)
 
 
 def _distributed_client_active() -> bool:

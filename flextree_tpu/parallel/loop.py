@@ -1,4 +1,4 @@
-"""Training loop driver: steps + checkpointing + logging + resume.
+"""Training loop driver: steps + checkpointing + logging + resume + recovery.
 
 Composes the pieces the rest of the package provides — any of the three
 train steps (dense dp/sp/tp, pipeline, MoE), the ``LMDataset`` batch
@@ -7,23 +7,49 @@ user actually calls.  Resume is exact: the loop reads ``state['step']``
 after restoring and continues with ``dataset.batch_at(step)``, so a run
 interrupted at any step and resumed produces the same parameters as a
 straight-through run (pinned by tests).
+
+Crash safety (docs/FAILURE_MODEL.md): a NaN/Inf guard on the step metrics
+skips anomalous steps (the update is discarded, the batch is not retried
+this run), rewinds to the last verified checkpoint after
+``max_bad_steps`` *consecutive* anomalies, and gives up with
+:class:`TrainingDiverged` once ``max_rewinds`` rewinds have not cured the
+divergence.  Restores go through ``restore_train_state``'s integrity
+fallback, so a truncated newest checkpoint silently falls back one.  The
+run's :class:`RunReport` (anomalies, skipped steps, rewinds, checkpoint
+fallbacks) is returned on the :class:`FitResult` and, when a checkpoint
+dir is configured, written there as ``RUN_REPORT.json`` — including when
+the run dies with :class:`TrainingDiverged`, which is exactly when the
+postmortem needs it.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import math
+import os
 import time
 from typing import Any, Callable
 
 import jax
 import numpy as np
 
-from ..utils.checkpoint import latest_checkpoint, restore_train_state, save_train_state
+from ..utils.checkpoint import (
+    latest_checkpoint,
+    restore_train_state,
+    save_train_state,
+)
 from ..utils.logging import get_logger
 
-__all__ = ["FitConfig", "FitResult", "fit"]
+__all__ = ["FitConfig", "FitResult", "RunReport", "TrainingDiverged", "fit"]
 
 log = get_logger("flextree.train")
+
+
+class TrainingDiverged(RuntimeError):
+    """The NaN/Inf guard exhausted its recovery budget: ``max_bad_steps``
+    consecutive anomalies with no checkpoint to rewind to, or
+    ``max_rewinds`` rewinds that did not cure the divergence."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +64,31 @@ class FitConfig:
     # steps ahead on a daemon thread (``flextree_tpu.data.prefetch``) while
     # the current step runs on device
     prefetch: int = 2
+    # NaN/Inf guard: skip steps whose loss (or grad_norm, when the step
+    # reports one) is non-finite; after max_bad_steps CONSECUTIVE skips,
+    # rewind to the last verified checkpoint; after max_rewinds rewinds
+    # raise TrainingDiverged.  The check device_gets the metrics every
+    # step, so it synchronizes host and device (on accelerators this
+    # trades dispatch pipelining for catching the FIRST bad update before
+    # it compounds); nan_guard=False restores the fail-fast async loop.
+    nan_guard: bool = True
+    max_bad_steps: int = 3
+    max_rewinds: int = 2
+
+
+@dataclasses.dataclass
+class RunReport:
+    """End-of-run accounting of everything the recovery machinery did."""
+
+    anomalies: int = 0  # non-finite steps skipped
+    skipped_steps: list = dataclasses.field(default_factory=list)
+    rewinds: int = 0  # checkpoint rewinds after consecutive anomalies
+    ckpt_fallbacks: int = 0  # corrupt checkpoints skipped during restore
+    resumed_from: int = 0
+    init_retries: int = 0  # bring-up attempts beyond the first (launch layer)
+
+    def to_payload(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 @dataclasses.dataclass
@@ -46,6 +97,28 @@ class FitResult:
     losses: list  # (step, loss) pairs at log points
     steps_run: int
     resumed_from: int
+    report: RunReport = dataclasses.field(default_factory=RunReport)
+
+
+def _metrics_finite(metrics) -> bool:
+    """Host-side finiteness of the guard metrics (loss + grad norm)."""
+    for key in ("loss", "grad_norm"):
+        if key in metrics:
+            v = float(np.asarray(jax.device_get(metrics[key])))
+            if not math.isfinite(v):
+                return False
+    return True
+
+
+def _stamp_step(state: dict, step: int) -> dict:
+    """A copy of ``state`` with ``state['step']`` set to ``step`` (keeps
+    the step leaf the single source of truth when a step is skipped)."""
+    import jax.numpy as jnp
+
+    old = state["step"]
+    new = dict(state)
+    new["step"] = jnp.asarray(step, np.asarray(jax.device_get(old)).dtype)
+    return new
 
 
 def fit(
@@ -64,34 +137,90 @@ def fit(
     are addressed by it, checkpoints are named by it, and resume reads it
     back.  Pass ``mesh``/``state_specs`` to restore sharded.
     """
+    report = RunReport()
+
+    def _fallback(bad_path, exc):
+        report.ckpt_fallbacks += 1
+
+    def _restore():
+        return restore_train_state(
+            cfg.ckpt_dir, mesh=mesh, specs=state_specs, on_fallback=_fallback
+        )
+
     resumed_from = 0
     if cfg.resume and cfg.ckpt_dir and latest_checkpoint(cfg.ckpt_dir):
-        state = restore_train_state(
-            cfg.ckpt_dir, mesh=mesh, specs=state_specs
-        )
+        state = _restore()
         resumed_from = int(np.asarray(jax.device_get(state["step"])))
+        report.resumed_from = resumed_from
         log.info("resumed from step %d (%s)", resumed_from, cfg.ckpt_dir)
 
     losses: list = []
     start = int(np.asarray(jax.device_get(state["step"])))
     t0 = time.perf_counter()
     step = start
-    batches = None
-    if cfg.prefetch and start < cfg.num_steps and hasattr(dataset, "iter_from"):
-        from ..data import prefetch as _prefetch
+    bad_streak = 0
 
-        batches = _prefetch(dataset.iter_from(start), size=cfg.prefetch)
-    while step < cfg.num_steps:
-        tokens, targets = next(batches) if batches is not None else dataset.batch_at(step)
-        state, metrics = step_fn(state, tokens, targets)
-        step += 1
-        if cfg.log_every and (step % cfg.log_every == 0 or step == cfg.num_steps):
-            loss = float(metrics["loss"])
-            losses.append((step, loss))
-            rate = (step - start) / (time.perf_counter() - t0)
-            log.info("step %d loss %.4f (%.1f steps/s)", step, loss, rate)
-        if cfg.ckpt_dir and cfg.ckpt_every and step % cfg.ckpt_every == 0:
+    def _batches(from_step):
+        if cfg.prefetch and from_step < cfg.num_steps and hasattr(dataset, "iter_from"):
+            from ..data import prefetch as _prefetch
+
+            return _prefetch(dataset.iter_from(from_step), size=cfg.prefetch)
+        return None
+
+    batches = _batches(start)
+    try:
+        while step < cfg.num_steps:
+            tokens, targets = (
+                next(batches) if batches is not None else dataset.batch_at(step)
+            )
+            new_state, metrics = step_fn(state, tokens, targets)
+            if cfg.nan_guard and not _metrics_finite(metrics):
+                report.anomalies += 1
+                report.skipped_steps.append(step)
+                bad_streak += 1
+                log.warning(
+                    "step %d: non-finite loss/grad (%d consecutive) — update skipped",
+                    step, bad_streak,
+                )
+                if bad_streak >= cfg.max_bad_steps:
+                    if not (cfg.ckpt_dir and latest_checkpoint(cfg.ckpt_dir)):
+                        raise TrainingDiverged(
+                            f"{bad_streak} consecutive non-finite steps at step "
+                            f"{step} and no checkpoint to rewind to"
+                        )
+                    if report.rewinds >= cfg.max_rewinds:
+                        raise TrainingDiverged(
+                            f"still diverging after {report.rewinds} rewinds "
+                            f"(step {step})"
+                        )
+                    state = _restore()
+                    report.rewinds += 1
+                    bad_streak = 0
+                    step = int(np.asarray(jax.device_get(state["step"])))
+                    log.warning("rewound to checkpointed step %d", step)
+                    batches = _batches(step)
+                    continue
+                # skip: discard the poisoned update, advance past the batch
+                step += 1
+                state = _stamp_step(state, step)
+                continue
+            state = new_state
+            bad_streak = 0
+            step += 1
+            if cfg.log_every and (step % cfg.log_every == 0 or step == cfg.num_steps):
+                loss = float(metrics["loss"])
+                losses.append((step, loss))
+                rate = (step - start) / (time.perf_counter() - t0)
+                log.info("step %d loss %.4f (%.1f steps/s)", step, loss, rate)
+            if cfg.ckpt_dir and cfg.ckpt_every and step % cfg.ckpt_every == 0:
+                save_train_state(cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep)
+        if cfg.ckpt_dir and step > start:
             save_train_state(cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep)
-    if cfg.ckpt_dir and step > start:
-        save_train_state(cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep)
-    return FitResult(state, losses, step - start, resumed_from)
+    finally:
+        # the accounting matters MOST for runs that die (a TrainingDiverged
+        # postmortem needs the anomaly/rewind trail) — write it regardless
+        if cfg.ckpt_dir:
+            os.makedirs(cfg.ckpt_dir, exist_ok=True)
+            with open(os.path.join(cfg.ckpt_dir, "RUN_REPORT.json"), "w") as f:
+                json.dump(report.to_payload(), f, indent=2, sort_keys=True)
+    return FitResult(state, losses, step - start, resumed_from, report)

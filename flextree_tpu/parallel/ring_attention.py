@@ -38,6 +38,20 @@ __all__ = [
 _NEG_INF = -1e30
 
 
+def varying_zeros(ref, dtype=None):
+    """Exact zeros that inherit ``ref``'s varying mesh axes (vma).
+
+    The obvious derivation — ``ref * 0`` — is NaN wherever ``ref`` is
+    non-finite, so a masked hop built from it leaks a poisoned q's NaN/Inf
+    into hops that must contribute *exact zeros* (ADVICE r5), and the
+    training loop's NaN guard then sees divergence in rows the causal mask
+    says were never touched.  ``where`` on a ``ref``-derived predicate
+    keeps the varying axes while pinning every element to a finite 0.
+    """
+    z = jnp.where(jnp.isfinite(ref), 0.0, 0.0)
+    return z.astype(ref.dtype if dtype is None else dtype)
+
+
 def local_attention_block(q, k, v, q_pos, k_pos, *, causal: bool, scale: float,
                           m, l, acc):
     """One online-softmax accumulation step over a single K/V block.
@@ -168,10 +182,11 @@ def _ring_attention_flash(q, k, v, axis_name, *, causal: bool,
     def masked_hop(k_blk, v_blk):
         # outputs derive from q to inherit its varying manual axes (vma):
         # a bare jnp.full constant is unvarying and fails shard_map's vma
-        # check against the other lax.switch branches
+        # check against the other lax.switch branches — but they must be
+        # *finite* zeros even for a non-finite q (varying_zeros, not q*0)
         return (
-            q * 0,
-            (q[..., 0] * 0).astype(jnp.float32) + _NEG_INF,
+            varying_zeros(q),
+            varying_zeros(q[..., 0], jnp.float32) + _NEG_INF,
         )
 
     if n == 1:

@@ -59,7 +59,8 @@ def heads_to_seq(x, axis_name):
 
 
 def ulysses_attention(q, k, v, axis_name, *, causal: bool = True,
-                      scale: float | None = None, impl: str = "reference"):
+                      scale: float | None = None, impl: str = "reference",
+                      **flash_kwargs):
     """Exact attention with sequence sharded over ``axis_name``.
 
     Same contract as ``ring_attention``: ``q``/``k``/``v`` are
@@ -73,12 +74,17 @@ def ulysses_attention(q, k, v, axis_name, *, causal: bool = True,
     or "flash" (the fused Pallas kernel, ``ops.pallas_attention``; the
     enclosing ``shard_map`` must pass ``check_vma=False`` because
     ``pallas_call`` outputs carry no varying-mesh-axes type).
+    ``flash_kwargs`` (block_q / block_k / variant, ...) forward to the
+    inner :func:`local_attention` — the re-shard makes it a
+    full-sequence-local call, so a tuned flash config applies here just
+    like on the unsharded path (rejected for non-flash impls).
     """
     with jax.named_scope("ulysses_seq2head"):
         qh = seq_to_heads(q, axis_name)
         kh = seq_to_heads(k, axis_name)
         vh = seq_to_heads(v, axis_name)
     with jax.named_scope("ulysses_local_attn"):
-        out = local_attention(qh, kh, vh, causal=causal, scale=scale, impl=impl)
+        out = local_attention(qh, kh, vh, causal=causal, scale=scale,
+                              impl=impl, **flash_kwargs)
     with jax.named_scope("ulysses_head2seq"):
         return heads_to_seq(out, axis_name)

@@ -136,7 +136,12 @@ def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
     oracle path).
     """
     from ..ops.pallas_attention import flash_attention
-    from .ring_attention import attention_reference, hop_finalize, hop_merge
+    from .ring_attention import (
+        attention_reference,
+        hop_finalize,
+        hop_merge,
+        varying_zeros,
+    )
 
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
@@ -182,10 +187,12 @@ def zigzag_ring_attention(q, k, v, axis_name, *, scale: float | None = None,
     def masked_hop(qb, kb, vb):
         # derive both outputs from qb so they inherit its varying manual
         # axes — a bare jnp.full constant is unvarying and fails the
-        # enclosing shard_map's vma check against the other switch branches
+        # enclosing shard_map's vma check against the other switch branches.
+        # varying_zeros, not qb*0: the hop must contribute exact zeros even
+        # when qb carries an injected NaN/Inf (ADVICE r5)
         return (
-            qb * 0,
-            (qb[..., 0] * 0).astype(jnp.float32) + _NEG_INF,
+            varying_zeros(qb),
+            varying_zeros(qb[..., 0], jnp.float32) + _NEG_INF,
         )
 
     q_e, q_l = q[:, :c], q[:, c:]

@@ -31,7 +31,13 @@ from .calibrate import (
     save_calibration,
     spearman,
 )
-from .choose import Candidate, Plan, candidate_topologies, choose_topology
+from .choose import (
+    Candidate,
+    Plan,
+    candidate_topologies,
+    choose_topology,
+    replan_for_survivors,
+)
 from .factorize import (
     count_ordered_factorizations,
     is_prime,
@@ -69,6 +75,7 @@ __all__ = [
     "Plan",
     "candidate_topologies",
     "choose_topology",
+    "replan_for_survivors",
     "count_ordered_factorizations",
     "is_prime",
     "ordered_factorizations",

@@ -24,6 +24,7 @@ prefers those when a mesh shape is provided.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass
 
@@ -36,7 +37,13 @@ from .cost_model import (
 )
 from .factorize import is_prime, ordered_factorizations
 
-__all__ = ["Candidate", "Plan", "choose_topology", "candidate_topologies"]
+__all__ = [
+    "Candidate",
+    "Plan",
+    "choose_topology",
+    "candidate_topologies",
+    "replan_for_survivors",
+]
 
 
 @dataclass(frozen=True)
@@ -244,3 +251,44 @@ def choose_topology(
         topo = Topology(n, best.widths)
 
     return Plan(n, nbytes, topo, tuple(cands), advisory)
+
+
+def replan_for_survivors(
+    n_alive: int,
+    nbytes: int,
+    params: TpuCostParams | None = None,
+    configured: int | None = None,
+) -> Plan:
+    """Degrade-to-survivors replanning: the cheapest *executable* topology
+    for the ranks that actually joined (docs/FAILURE_MODEL.md §replanning).
+
+    When a configured world never assembles (a host never joins before the
+    bring-up deadline, ``parallel.launch.init_distributed_or_degrade``),
+    the job can run on the survivors instead of aborting — but the planned
+    topology no longer fits: widths must factor ``n_alive``, not the
+    configured count.  This re-runs the chooser for ``n_alive``; awkward
+    survivor counts get real shapes because the candidate table already
+    includes the ring and, for prime counts, executable lonely ``+1``
+    topologies (7 of 8 alive runs ``3,2+1`` rather than idling a rank).
+
+    Survivor worlds are priced fabric-uniform (no ``mesh_shape``): losing
+    arbitrary ranks breaks torus alignment, so axis-exact costing would be
+    optimistic about shapes that no longer tile anything.
+
+    ``configured``: the originally requested world size — recorded in the
+    plan's advisory so artifacts show the degradation.
+    """
+    if n_alive < 1:
+        raise ValueError(f"n_alive must be >= 1, got {n_alive}")
+    if configured is not None and n_alive > configured:
+        raise ValueError(
+            f"n_alive {n_alive} exceeds the configured world {configured}"
+        )
+    plan = choose_topology(n_alive, nbytes, params=params)
+    if configured is not None and n_alive < configured:
+        note = (
+            f"DEGRADED WORLD: {n_alive}/{configured} ranks alive; "
+            f"replanned to topo {plan.to_ft_topo()}"
+        )
+        plan = dataclasses.replace(plan, advisory=(note,) + plan.advisory)
+    return plan

@@ -2,12 +2,14 @@
 
 from .buildstamp import artifact_meta, build_info, version_string
 from .checkpoint import (
+    CheckpointCorrupt,
     latest_checkpoint,
     list_checkpoints,
     restore_checkpoint,
     restore_train_state,
     save_checkpoint,
     save_train_state,
+    verify_checkpoint,
 )
 from .logging import get_logger, result_file_name, write_result_file
 from .profiling import PhaseTimer, debug_dump_schedule, debug_enabled, phase_timer, trace
@@ -23,6 +25,8 @@ __all__ = [
     "restore_train_state",
     "latest_checkpoint",
     "list_checkpoints",
+    "verify_checkpoint",
+    "CheckpointCorrupt",
     "get_logger",
     "result_file_name",
     "write_result_file",

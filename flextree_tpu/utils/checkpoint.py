@@ -17,6 +17,12 @@ gap the TPU way:
 - **Rotation**: ``save_train_state`` names files by step
   (``ckpt_{step:08d}.npz``) and prunes beyond ``max_to_keep``;
   ``latest_checkpoint``/``restore_train_state`` resume from the newest.
+- **Integrity**: every leaf's CRC32 is recorded in the structure
+  descriptor at save time and re-verified on restore, so a truncated or
+  bit-flipped checkpoint raises :class:`CheckpointCorrupt` instead of
+  silently resuming from garbage; ``restore_train_state`` then *falls
+  back* to the next-newest checkpoint that verifies (the crash-safe
+  restore the chaos harness exercises — docs/FAILURE_MODEL.md).
 
 Bitwise-exact resume (same mesh, same data ordering) is pinned by the
 tests: train k steps == train j, save, restore, train k-j.
@@ -28,6 +34,7 @@ import json
 import os
 import re
 import tempfile
+import zlib
 
 import jax
 import numpy as np
@@ -39,7 +46,14 @@ __all__ = [
     "restore_train_state",
     "latest_checkpoint",
     "list_checkpoints",
+    "verify_checkpoint",
+    "CheckpointCorrupt",
 ]
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed integrity verification: unreadable/truncated
+    archive, missing leaves, or a per-leaf checksum mismatch."""
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 
@@ -62,8 +76,14 @@ def _encode(tree, leaves: list):
     a = np.asarray(tree)
     leaves.append(a)
     # npz stores extension dtypes (bfloat16, float8_*) as raw void bytes;
-    # record the true dtype so restore can view it back
-    return {"t": "leaf", "i": len(leaves) - 1, "dtype": str(a.dtype)}
+    # record the true dtype so restore can view it back.  The CRC32 covers
+    # the raw bytes (dtype-view invariant) so restore can verify integrity.
+    return {
+        "t": "leaf",
+        "i": len(leaves) - 1,
+        "dtype": str(a.dtype),
+        "crc": _leaf_crc(a),
+    }
 
 
 def _decode(node, leaves):
@@ -77,6 +97,30 @@ def _decode(node, leaves):
     if t == "none":
         return None
     return _restore_dtype(leaves[node["i"]], node.get("dtype"))
+
+
+def _leaf_crc(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
+
+
+def _verify_leaves(node, leaves, path: str):
+    """Walk the structure descriptor, re-checksumming every leaf."""
+    t = node["t"]
+    if t == "dict":
+        for v in node["items"].values():
+            _verify_leaves(v, leaves, path)
+    elif t in ("list", "tuple"):
+        for v in node["items"]:
+            _verify_leaves(v, leaves, path)
+    elif t == "leaf" and "crc" in node:  # pre-integrity checkpoints lack crc
+        if node["i"] >= len(leaves):
+            raise CheckpointCorrupt(
+                f"{path}: leaf_{node['i']} missing (truncated archive)"
+            )
+        if _leaf_crc(leaves[node["i"]]) != node["crc"]:
+            raise CheckpointCorrupt(
+                f"{path}: leaf_{node['i']} checksum mismatch (corrupt data)"
+            )
 
 
 def _restore_dtype(a: np.ndarray, dtype_str: str | None) -> np.ndarray:
@@ -118,17 +162,29 @@ def save_checkpoint(path: str | os.PathLike, tree) -> str:
     return path
 
 
-def restore_checkpoint(path: str | os.PathLike, mesh=None, specs=None):
+def restore_checkpoint(path: str | os.PathLike, mesh=None, specs=None, *, verify=True):
     """Load a checkpoint; optionally place leaves sharded over ``mesh``.
 
     With ``mesh``/``specs`` (a PartitionSpec pytree matching the saved
     structure) every leaf is ``device_put`` under the corresponding
     ``NamedSharding``; otherwise plain NumPy arrays come back.
+
+    ``verify`` (default on) re-checksums every leaf against the CRC32s the
+    save recorded; an unreadable archive or a mismatch raises
+    :class:`CheckpointCorrupt` (checkpoints from before the integrity
+    scheme carry no CRCs and load unverified).
     """
     path = os.fspath(path)
-    with np.load(path) as data:
-        structure = json.loads(bytes(data["__structure__"]).decode())
-        leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
+    try:
+        with np.load(path) as data:
+            structure = json.loads(bytes(data["__structure__"]).decode())
+            leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # truncated zip, missing keys, bad JSON, ...
+        raise CheckpointCorrupt(f"unreadable checkpoint {path}: {e}") from e
+    if verify:
+        _verify_leaves(structure, leaves, path)
     tree = _decode(structure, leaves)
     if mesh is None:
         return tree
@@ -190,14 +246,46 @@ def save_train_state(
     return path
 
 
+def verify_checkpoint(path: str | os.PathLike) -> bool:
+    """Whether ``path`` loads and passes leaf-checksum verification."""
+    try:
+        restore_checkpoint(path)
+        return True
+    except (CheckpointCorrupt, FileNotFoundError):
+        return False
+
+
 def restore_train_state(
-    ckpt_dir_or_path: str | os.PathLike, mesh=None, specs=None
+    ckpt_dir_or_path: str | os.PathLike, mesh=None, specs=None, *, on_fallback=None
 ):
-    """Restore the newest train state from a directory (or an exact path)."""
+    """Restore the newest train state from a directory (or an exact path).
+
+    Crash-safe: when the newest checkpoint in a directory is truncated or
+    corrupt (a crash mid-write on a non-atomic filesystem, a bad disk), it
+    falls back to the next-newest that verifies, oldest-last, calling
+    ``on_fallback(bad_path, exc)`` for each rejected file; only when
+    *every* checkpoint fails does it raise :class:`CheckpointCorrupt`.
+    An exact file path gets no fallback — corruption raises.
+    """
     path = os.fspath(ckpt_dir_or_path)
-    if os.path.isdir(path):
-        latest = latest_checkpoint(path)
-        if latest is None:
-            raise FileNotFoundError(f"no checkpoints in {path}")
-        path = latest
-    return restore_checkpoint(path, mesh=mesh, specs=specs)
+    if not os.path.isdir(path):
+        return restore_checkpoint(path, mesh=mesh, specs=specs)
+    ckpts = list_checkpoints(path)
+    if not ckpts:
+        raise FileNotFoundError(f"no checkpoints in {path}")
+    last_exc = None
+    for _, p in reversed(ckpts):
+        try:
+            return restore_checkpoint(p, mesh=mesh, specs=specs)
+        except CheckpointCorrupt as e:
+            from .logging import get_logger
+
+            get_logger("flextree.ckpt").warning(
+                "checkpoint %s failed verification (%s); falling back", p, e
+            )
+            if on_fallback is not None:
+                on_fallback(p, e)
+            last_exc = e
+    raise CheckpointCorrupt(
+        f"every checkpoint in {path} failed verification"
+    ) from last_exc

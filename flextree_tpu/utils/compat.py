@@ -1,0 +1,70 @@
+"""Version-compatibility shims for the pinned JAX.
+
+The codebase targets the current JAX API surface (``jax.shard_map``, the
+``jax_num_cpu_devices`` config option); older pins (0.4.x, as baked into
+some containers) spell both differently.  Importing this module — which
+``flextree_tpu/__init__`` does — installs the aliases, so every call site
+can keep using the modern spelling.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["request_cpu_devices"]
+
+if not hasattr(jax, "shard_map"):  # JAX < 0.6: experimental namespace
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    @functools.wraps(_exp_shard_map)
+    def _shard_map(f, *args, check_vma=None, **kw):
+        # modern spelling of the replication check; same False-to-disable
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _exp_shard_map(f, *args, **kw)
+
+    jax.shard_map = _shard_map
+
+if not hasattr(jax.lax, "axis_size"):
+    # psum of a Python literal is special-cased to the concrete axis size
+    # at trace time (no collective); capture psum now so the interposer
+    # (flextree_tpu.interpose) shadowing jax.lax.psum can't recurse into it
+    _psum = jax.lax.psum
+
+    def _axis_size(axis_name):
+        return _psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+
+def request_cpu_devices(n: int) -> None:
+    """Pin ``n`` virtual CPU devices on either config spelling.
+
+    Like the option it wraps, this must run before the CPU backend
+    initializes; on JAX < 0.5 it falls back to the XLA host-platform flag
+    (same lever, read at backend init).  An inherited flag is *replaced*,
+    not respected: XLA_FLAGS leaks through os.environ into subprocesses
+    (the multi-process bring-up tools spawn children from a test process
+    that pinned a different count), and keeping the parent's value would
+    silently hand every child the wrong device count.  Mirrors the config
+    option's contract by raising RuntimeError once backends exist.
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            raise RuntimeError(
+                "request_cpu_devices must run before backends initialize"
+            )
+        flags = [
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count=")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)

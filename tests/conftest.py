@@ -26,8 +26,10 @@ os.environ.pop("FLEXTREE_CALIBRATION_BACKEND", None)
 
 import jax
 
+from flextree_tpu.utils.compat import request_cpu_devices  # also shims jax API
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+request_cpu_devices(8)
 jax.config.update("jax_enable_x64", True)
 
 

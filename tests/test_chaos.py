@@ -1,0 +1,589 @@
+"""The executed failure model (docs/FAILURE_MODEL.md).
+
+Three layers of chaos, each pinned against the acceptance contract "no
+injected fault yields a silently wrong allreduce result":
+
+- **Simulator matrix**: a ``FaultPlan`` drives every schedule family
+  (tree, ring, lonely) through drop / duplicate / reorder / corrupt /
+  delay / kill.  Detected faults must raise :class:`FaultDetected` naming
+  the faulty (stage, src, dst); recovered faults (duplicate, reorder, a
+  lonely rank dying after its contribution is folded) must leave the
+  result bitwise-identical to the fault-free run and leave an audit trail
+  in ``plan.events``.
+- **Checkpoint corruption**: a truncated or bit-flipped newest checkpoint
+  must fail verification and fall back one checkpoint, and a ``fit``
+  resume through that fallback must be bitwise-exact.
+- **Training-loop anomalies**: injected NaN losses are skipped (with
+  ``RunReport`` accounting), cured by rewind-to-checkpoint, or — when the
+  divergence persists past the rewind budget — rejected with
+  :class:`TrainingDiverged`.
+
+The kill/restart/degrade bring-up of a real two-process world lives in
+``tools/chaos_bringup.py`` and runs here under the ``slow`` marker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flextree_tpu.backends import (
+    Fault,
+    FaultDetected,
+    FaultPlan,
+    simulate_allreduce,
+)
+from flextree_tpu.backends.simulator import WHOLE_PAYLOAD
+from flextree_tpu.parallel.loop import FitConfig, TrainingDiverged, fit
+from flextree_tpu.utils.checkpoint import (
+    CheckpointCorrupt,
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    restore_train_state,
+    save_train_state,
+    verify_checkpoint,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RNG = np.random.default_rng(7)
+
+# one representative of each schedule family the entry point can route to
+TOPOS = [
+    pytest.param(8, "4,2", id="tree-4,2"),
+    pytest.param(8, "2,2,2", id="tree-2,2,2"),
+    pytest.param(8, "1", id="ring"),
+    pytest.param(7, "3,2+1", id="lonely-3,2+1"),
+]
+
+# fault kinds the transport cannot mask and must therefore *detect*
+DETECTED_KINDS = ("drop", "corrupt", "delay")
+# fault kinds the tag-matched mailbox absorbs and must *recover*
+RECOVERED_KINDS = ("duplicate", "reorder")
+
+
+def _dense_sum(data):
+    return np.tile(data.sum(axis=0), (data.shape[0], 1))
+
+
+# ----------------------------------------------------- simulator matrix
+
+
+@pytest.mark.parametrize("kind", DETECTED_KINDS)
+@pytest.mark.parametrize("n,topo", TOPOS)
+def test_fault_detected_with_named_coordinates(n, topo, kind):
+    """A sniped stage-0 message from rank 0 to rank 1 (every family has
+    one) must surface as FaultDetected carrying the exact coordinates —
+    structured fields AND the human-readable diagnostic."""
+    data = RNG.standard_normal((n, 64))
+    plan = FaultPlan(faults=(Fault(kind, stage=0, src=0, dst=1),))
+    with pytest.raises(FaultDetected) as ei:
+        simulate_allreduce(data, topo, faults=plan)
+    e = ei.value
+    assert e.kind == kind
+    assert (e.stage, e.src, e.dst) == (0, 0, 1)
+    assert "stage 0" in str(e) and "src 0 -> dst 1" in str(e)
+    actions = {ev.action for ev in plan.events if ev.kind == kind}
+    assert {"injected", "detected"} <= actions, plan.events
+
+
+@pytest.mark.parametrize("kind", DETECTED_KINDS)
+@pytest.mark.parametrize("n,topo", TOPOS)
+def test_blanket_fault_never_silently_wrong(n, topo, kind):
+    """Faulting EVERY message (wildcard) must still detect, never return."""
+    data = RNG.standard_normal((n, 32))
+    with pytest.raises(FaultDetected):
+        simulate_allreduce(data, topo, faults=(Fault(kind),))
+
+
+@pytest.mark.parametrize("kind", RECOVERED_KINDS)
+@pytest.mark.parametrize("n,topo", TOPOS)
+def test_recovered_fault_keeps_result_exact(n, topo, kind):
+    """Duplicates are deduplicated by tag and reorders are absorbed by tag
+    matching: the result must equal the fault-free run bit for bit, and
+    the plan must show the faults were exercised, not unmatched."""
+    data = RNG.standard_normal((n, 64))
+    clean = simulate_allreduce(data, topo)
+    plan = FaultPlan(faults=(Fault(kind),))  # every message, all stages
+    out = simulate_allreduce(data, topo, faults=plan)
+    np.testing.assert_array_equal(out, clean)
+    assert any(
+        ev.kind == kind and ev.action == "injected" for ev in plan.events
+    ), "wildcard fault was never exercised"
+    if kind == "duplicate":
+        assert any(
+            ev.kind == kind and ev.action == "recovered" for ev in plan.events
+        ), "no dedup recovery recorded"
+
+
+@pytest.mark.parametrize("n,topo", TOPOS)
+def test_killed_rank_detected_by_surviving_peer(n, topo):
+    """Kill rank 1 before its first message: the first survivor that
+    needs its data must name the dead source."""
+    data = RNG.standard_normal((n, 64))
+    plan = FaultPlan(kill={1: 0})
+    with pytest.raises(FaultDetected) as ei:
+        simulate_allreduce(data, topo, faults=plan)
+    e = ei.value
+    assert e.kind == "kill"
+    assert e.src == 1
+    assert "rank 1 died at stage 0" in str(e)
+
+
+def test_lonely_fold_hop_is_chaos_reachable():
+    """The lonely buddy fold rides the mailbox too: drop the lonely
+    rank's whole-payload hop and the buddy must detect it at phase 0."""
+    data = RNG.standard_normal((7, 64))
+    plan = FaultPlan(faults=(Fault("drop", src=6, dst=0),))
+    with pytest.raises(FaultDetected) as ei:
+        simulate_allreduce(data, "3,2+1", faults=plan)
+    e = ei.value
+    assert (e.kind, e.phase, e.src, e.dst) == ("drop", 0, 6, 0)
+    assert e.block == WHOLE_PAYLOAD and "whole payload" in str(e)
+
+
+def test_dead_lonely_rank_degrades_to_survivors():
+    """A lonely rank dying AFTER its payload is folded must not sink the
+    collective: survivors complete with the full sum (its contribution
+    was already in) and the skip is recorded, not silent."""
+    n, spec = 7, "3,2+1"
+    data = RNG.standard_normal((n, 64))
+    # (3,2) tree has 2 stages -> schedule times 0..3; the buddy-return hop
+    # runs at time 4, so a kill at 4 hits only the result return
+    plan = FaultPlan(kill={6: 4})
+    out = simulate_allreduce(data, spec, faults=plan)
+    np.testing.assert_allclose(out[:6], _dense_sum(data)[:6], rtol=1e-12)
+    assert any(
+        ev.kind == "kill" and ev.action == "recovered" for ev in plan.events
+    )
+
+
+def test_blanket_corrupt_with_empty_tail_blocks_still_detects():
+    """count < n leaves zero-length tail blocks in flight; a wildcard
+    corrupt fault must skip them (no bytes to flip) and still be detected
+    on the first non-empty payload — not crash on the empty one."""
+    data = RNG.standard_normal((5, 3))
+    for topo in ("1", "5"):
+        with pytest.raises(FaultDetected) as ei:
+            simulate_allreduce(data, topo, faults=(Fault("corrupt"),))
+        assert ei.value.kind == "corrupt"
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        Fault("gamma-ray")
+
+
+def test_faultless_plan_leaves_result_and_events_untouched():
+    data = RNG.standard_normal((8, 64))
+    plan = FaultPlan()
+    out = simulate_allreduce(data, "4,2", faults=plan)
+    np.testing.assert_array_equal(out, simulate_allreduce(data, "4,2"))
+    assert plan.events == []
+
+
+# ------------------------------------------------- checkpoint integrity
+
+
+def _truncate(path, frac=0.6):
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[: int(len(raw) * frac)])
+
+
+def _state(step, scale=1.0):
+    return {
+        "step": np.int64(step),
+        "w": np.arange(16, dtype=np.float64) * scale,
+        "opt": {"m": np.ones((4, 4)) * scale},
+    }
+
+
+def test_truncated_checkpoint_fails_verification(tmp_path):
+    save_train_state(tmp_path, _state(4))
+    path = latest_checkpoint(tmp_path)
+    assert verify_checkpoint(path)
+    _truncate(path)
+    assert not verify_checkpoint(path)
+    with pytest.raises(CheckpointCorrupt):
+        restore_checkpoint(path)
+
+
+def test_bitflipped_leaf_fails_checksum(tmp_path):
+    """Rewrite one leaf without updating the recorded CRC: the structure
+    descriptor's per-leaf checksum must catch the tamper."""
+    save_train_state(tmp_path, _state(4))
+    path = latest_checkpoint(tmp_path)
+    with np.load(path) as data:
+        arrs = {k: np.array(data[k]) for k in data.files}
+    fat = max(
+        (k for k in arrs if k.startswith("leaf_")), key=lambda k: arrs[k].nbytes
+    )
+    arrs[fat].view(np.uint8).flat[0] ^= 0xFF
+    np.savez(path, **arrs)
+    with pytest.raises(CheckpointCorrupt, match="checksum mismatch"):
+        restore_checkpoint(path)
+
+
+def test_restore_falls_back_past_corrupt_newest(tmp_path):
+    save_train_state(tmp_path, _state(4, scale=1.0))
+    save_train_state(tmp_path, _state(8, scale=2.0))
+    newest = latest_checkpoint(tmp_path)
+    _truncate(newest)
+    rejected = []
+    got = restore_train_state(
+        tmp_path, on_fallback=lambda p, e: rejected.append(p)
+    )
+    assert int(got["step"]) == 4
+    np.testing.assert_array_equal(got["w"], _state(4)["w"])
+    assert rejected == [newest]
+
+
+def test_restore_raises_when_every_checkpoint_is_corrupt(tmp_path):
+    save_train_state(tmp_path, _state(4))
+    save_train_state(tmp_path, _state(8))
+    for _, path in list_checkpoints(tmp_path):
+        _truncate(path)
+    with pytest.raises(CheckpointCorrupt, match="every checkpoint"):
+        restore_train_state(tmp_path)
+
+
+# ------------------------------------------------ crash-safe training loop
+
+
+class _ToyData:
+    """Deterministic step-addressed batches (mean of batch s is s+1).
+    Deliberately lacks ``iter_from`` so ``fit`` uses direct addressing."""
+
+    def batch_at(self, step):
+        tok = np.full((2, 4), float(step + 1))
+        return tok, tok
+
+
+def _toy_step(poison: set | None = None):
+    """A linear 'model': w -= 0.01 * mean(batch).  Steps whose index is in
+    ``poison`` produce a NaN loss exactly once (the set is consumed), the
+    way a transient numeric anomaly would."""
+    poison = poison if poison is not None else set()
+
+    def step_fn(state, tokens, targets):
+        s = int(np.asarray(state["step"]))
+        g = np.float64(tokens.mean())
+        if s in poison:
+            poison.discard(s)
+            g = np.float64("nan")
+        return (
+            {"step": np.int64(s + 1), "w": np.asarray(state["w"]) - 0.01 * g},
+            {"loss": g},
+        )
+
+    return step_fn
+
+
+def _w0():
+    return {"step": np.int64(0), "w": np.zeros(4, dtype=np.float64)}
+
+
+def _expected_w(applied_steps):
+    return -0.01 * sum(s + 1 for s in applied_steps) * np.ones(4)
+
+
+def test_nan_step_is_skipped_and_counted(tmp_path):
+    """Acceptance (a): an injected NaN loss at step k is skipped — the
+    poisoned update is discarded, the run completes, and the RunReport
+    (returned and persisted as RUN_REPORT.json) carries the accounting."""
+    ck = str(tmp_path / "ck")
+    res = fit(
+        _w0(), _toy_step(poison={3}), _ToyData(),
+        FitConfig(num_steps=8, ckpt_dir=ck, ckpt_every=100, log_every=0),
+    )
+    assert res.steps_run == 8
+    assert res.report.anomalies == 1
+    assert res.report.skipped_steps == [3]
+    np.testing.assert_allclose(
+        res.state["w"], _expected_w(s for s in range(8) if s != 3)
+    )
+    with open(os.path.join(ck, "RUN_REPORT.json")) as f:
+        persisted = json.load(f)
+    assert persisted["anomalies"] == 1 and persisted["skipped_steps"] == [3]
+
+
+def test_nan_burst_cured_by_rewind(tmp_path):
+    """max_bad_steps consecutive anomalies trigger a rewind to the last
+    checkpoint; a transient burst is then replayed clean, so the final
+    parameters match an undisturbed run exactly."""
+    ck = str(tmp_path / "ck")
+    res = fit(
+        _w0(), _toy_step(poison={4, 5, 6}), _ToyData(),
+        FitConfig(
+            num_steps=12, ckpt_dir=ck, ckpt_every=4, log_every=0,
+            max_bad_steps=3, max_rewinds=2,
+        ),
+    )
+    assert res.report.rewinds == 1
+    assert res.report.anomalies == 3
+    # rewound to step 4, replayed 4..11 clean: every update applied
+    np.testing.assert_allclose(res.state["w"], _expected_w(range(12)))
+    clean = fit(
+        _w0(), _toy_step(), _ToyData(),
+        FitConfig(num_steps=12, log_every=0),
+    )
+    np.testing.assert_array_equal(res.state["w"], clean.state["w"])
+
+
+def test_persistent_divergence_raises_after_rewind_budget(tmp_path):
+    """A divergence that reappears after every rewind must end in
+    TrainingDiverged, not an infinite rewind loop."""
+    ck = str(tmp_path / "ck")
+    # re-arm the poison on every pass: steps >= 4 are always NaN
+    class _AlwaysPoisoned(set):
+        def discard(self, item):
+            pass
+
+    with pytest.raises(TrainingDiverged, match="rewind"):
+        fit(
+            _w0(), _toy_step(poison=_AlwaysPoisoned(range(4, 100))), _ToyData(),
+            FitConfig(
+                num_steps=12, ckpt_dir=ck, ckpt_every=2, log_every=0,
+                max_bad_steps=3, max_rewinds=1,
+            ),
+        )
+
+
+def test_run_report_persisted_when_training_diverges(tmp_path):
+    """The accounting matters most for the run that dies: RUN_REPORT.json
+    must exist (anomalies + rewinds recorded) after TrainingDiverged."""
+    ck = str(tmp_path / "ck")
+
+    class _AlwaysPoisoned(set):
+        def discard(self, item):
+            pass
+
+    with pytest.raises(TrainingDiverged):
+        fit(
+            _w0(), _toy_step(poison=_AlwaysPoisoned(range(4, 100))), _ToyData(),
+            FitConfig(
+                num_steps=12, ckpt_dir=ck, ckpt_every=2, log_every=0,
+                max_bad_steps=3, max_rewinds=1,
+            ),
+        )
+    with open(os.path.join(ck, "RUN_REPORT.json")) as f:
+        persisted = json.load(f)
+    assert persisted["rewinds"] == 1
+    assert persisted["anomalies"] == 6  # 3 before the rewind, 3 after
+
+
+def test_divergence_without_checkpoint_raises(tmp_path):
+    with pytest.raises(TrainingDiverged, match="no checkpoint"):
+        fit(
+            _w0(), _toy_step(poison={0, 1, 2}), _ToyData(),
+            FitConfig(num_steps=8, log_every=0, max_bad_steps=3),
+        )
+
+
+def test_nan_guard_off_restores_fail_fast(tmp_path):
+    """nan_guard=False: the poisoned update flows through unguarded (the
+    pre-chaos loop), pinning that the guard is opt-out, not silent."""
+    res = fit(
+        _w0(), _toy_step(poison={2}), _ToyData(),
+        FitConfig(num_steps=4, log_every=0, nan_guard=False),
+    )
+    assert not np.isfinite(np.asarray(res.state["w"])).all()
+
+
+def test_fit_resumes_exactly_through_corrupt_newest_checkpoint(tmp_path):
+    """Acceptance (b): corrupt the newest checkpoint of an interrupted
+    run; the resume must fall back one checkpoint and still reproduce the
+    straight-through run bitwise."""
+    ck = str(tmp_path / "ck")
+    straight = fit(
+        _w0(), _toy_step(), _ToyData(), FitConfig(num_steps=12, log_every=0)
+    )
+    half = fit(
+        _w0(), _toy_step(), _ToyData(),
+        FitConfig(num_steps=8, ckpt_dir=ck, ckpt_every=4, log_every=0),
+    )
+    assert half.steps_run == 8
+    _truncate(latest_checkpoint(ck))  # ckpt_00000008 dies mid-write
+    resumed = fit(
+        _w0(), _toy_step(), _ToyData(),
+        FitConfig(num_steps=12, ckpt_dir=ck, ckpt_every=4, log_every=0),
+    )
+    assert resumed.resumed_from == 4
+    assert resumed.report.ckpt_fallbacks == 1
+    assert resumed.steps_run == 8
+    np.testing.assert_array_equal(resumed.state["w"], straight.state["w"])
+
+
+# ---------------------------------------- NaN containment in attention
+
+
+def test_varying_zeros_stays_finite_for_poisoned_input():
+    """ADVICE r5: masked ring/zigzag hops derived their zeros as ``q * 0``,
+    which is NaN wherever q is non-finite — a poisoned shard then leaks
+    into hops the causal mask says contribute nothing.  The replacement
+    must be exact zeros for ANY input, preserving dtype."""
+    import jax.numpy as jnp
+
+    from flextree_tpu.parallel.ring_attention import varying_zeros
+
+    q = jnp.array([jnp.nan, jnp.inf, -jnp.inf, 1.0, 0.0])
+    assert np.isnan(np.asarray(q * 0)).any()  # the bug being guarded against
+    z = varying_zeros(q)
+    np.testing.assert_array_equal(np.asarray(z), np.zeros(5))
+    assert z.dtype == q.dtype
+    z32 = varying_zeros(q, jnp.float32)
+    assert z32.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(z32), np.zeros(5, np.float32))
+
+
+# -------------------------------------------- bring-up retry/backoff
+
+
+def _clean_ft_env(monkeypatch):
+    for var in ("FT_COORDINATOR", "FT_NUM_PROCESSES", "FT_PROCESS_ID",
+                "FT_INIT_TIMEOUT", "FT_INIT_RETRIES"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_init_retries_transient_failures_with_backoff(monkeypatch):
+    from flextree_tpu.parallel import launch as launch_mod
+    from flextree_tpu.parallel.launch import ClusterConfig, init_distributed
+
+    _clean_ft_env(monkeypatch)
+    naps, calls = [], []
+
+    def flaky_init(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise RuntimeError("connection refused (transient)")
+
+    monkeypatch.setattr(launch_mod, "_sleep", naps.append)
+    monkeypatch.setattr(launch_mod.jax.distributed, "initialize", flaky_init)
+    report = init_distributed(ClusterConfig("h0:1234", 2, 0), retries=5)
+    assert report.attempts == 3
+    assert len(report.errors) == 2
+    assert all("transient" in e for e in report.errors)
+    assert len(naps) == 2 and naps[1] >= naps[0]  # exponential, jittered
+
+
+def test_init_exhausted_budget_carries_error_taxonomy(monkeypatch):
+    from flextree_tpu.parallel import launch as launch_mod
+    from flextree_tpu.parallel.launch import (
+        BringupTimeout, ClusterConfig, init_distributed,
+    )
+
+    _clean_ft_env(monkeypatch)
+    monkeypatch.setattr(launch_mod, "_sleep", lambda s: None)
+
+    def doomed_init(**kw):
+        raise RuntimeError("DEADLINE_EXCEEDED")
+
+    monkeypatch.setattr(launch_mod.jax.distributed, "initialize", doomed_init)
+    with pytest.raises(BringupTimeout) as ei:
+        init_distributed(ClusterConfig("h0:1234", 2, 0), retries=2)
+    assert ei.value.attempts == 3  # first try + 2 retries
+    assert len(ei.value.errors) == 3
+    assert all("DEADLINE_EXCEEDED" in e for e in ei.value.errors)
+
+
+def test_malformed_config_fails_fast_without_retry(tmp_path, monkeypatch):
+    from flextree_tpu.parallel.launch import BringupConfigError, init_distributed
+
+    _clean_ft_env(monkeypatch)
+    bad = tmp_path / "cluster.json"
+    bad.write_text(json.dumps({"coordinator": "h0:1", "bogus_key": 1}))
+    with pytest.raises(BringupConfigError, match="bogus_key"):
+        init_distributed(bad)
+
+
+def test_nonzero_rank_probes_coordinator_before_handshake(monkeypatch):
+    """With a deadline configured, a non-coordinator waits for the
+    coordinator port OUTSIDE initialize (a deadline inside the handshake
+    hard-aborts the process on this JAX pin); the coordinator itself never
+    probes its own port."""
+    from flextree_tpu.parallel import launch as launch_mod
+    from flextree_tpu.parallel.launch import ClusterConfig, init_distributed
+
+    _clean_ft_env(monkeypatch)
+    probes, calls = [], []
+    monkeypatch.setattr(
+        launch_mod, "_probe_coordinator", lambda c, b: probes.append((c, b))
+    )
+    monkeypatch.setattr(
+        launch_mod.jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    init_distributed(ClusterConfig("h0:1234", 2, 1), timeout=7)
+    assert probes == [("h0:1234", 7)]
+    assert calls[-1]["initialization_timeout"] == 7
+    probes.clear()
+    init_distributed(ClusterConfig("h0:1234", 2, 0), timeout=7)
+    assert probes == []
+
+
+def test_degrade_decided_from_launcher_liveness(monkeypatch):
+    """A liveness source reporting a short world degrades upfront — the
+    doomed full-world barrier is never attempted — and the env process
+    count must not stomp the degraded world size."""
+    from flextree_tpu.parallel import launch as launch_mod
+    from flextree_tpu.parallel.launch import (
+        ClusterConfig, init_distributed_or_degrade,
+    )
+
+    _clean_ft_env(monkeypatch)
+    monkeypatch.setenv("FT_NUM_PROCESSES", "8")  # launcher-configured world
+    calls = []
+    monkeypatch.setattr(
+        launch_mod.jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    report, plan = init_distributed_or_degrade(
+        ClusterConfig("h0:1234", 8, 0), nbytes=1 << 20, survivors=lambda: 7
+    )
+    assert report.degraded_to == 7
+    assert calls == [
+        {"coordinator_address": "h0:1234", "num_processes": 7, "process_id": 0}
+    ]
+    assert plan is not None and plan.topology.num_nodes == 7
+    assert any("DEGRADED WORLD" in note for note in plan.advisory)
+
+
+def test_replan_for_survivors_validates_and_annotates():
+    from flextree_tpu.planner import replan_for_survivors
+
+    plan = replan_for_survivors(7, 1 << 20, configured=8)
+    assert plan.topology.num_nodes == 7
+    assert any("DEGRADED WORLD: 7/8" in note for note in plan.advisory)
+    with pytest.raises(ValueError, match="exceeds"):
+        replan_for_survivors(9, 1 << 20, configured=8)
+    with pytest.raises(ValueError, match=">= 1"):
+        replan_for_survivors(0, 1 << 20)
+
+
+# ------------------------------------------- executed two-process chaos
+
+
+@pytest.mark.slow
+def test_chaos_bringup_kill_restart_degrade():
+    """Acceptance (c), executed for real: late coordinator (retry/backoff
+    reconnect), killed-then-restarted process, and a never-joining process
+    (degrade-to-survivors with a replanned topology) — three scenarios of
+    ``tools/chaos_bringup.py`` against genuine local processes."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_bringup.py"),
+         "--no-artifact", "--port", "19951"],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        cwd=REPO,
+    )
+    assert p.returncode == 0, f"chaos bring-up failed:\n{p.stdout[-4000:]}"
+    for scenario in ("retry", "restart", "degrade"):
+        assert f"scenario {scenario}: OK" in p.stdout, p.stdout[-4000:]

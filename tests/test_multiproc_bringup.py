@@ -49,7 +49,11 @@ def test_committed_bringup_artifact_carries_timings():
     for cfg in ("psum", "flat:8", "two_level:4,2", "two_level:2,4", "ring"):
         assert t["configs"][cfg]["min_s"] > 0, cfg
         assert t["configs"][cfg]["avg_s"] >= t["configs"][cfg]["min_s"], cfg
-    assert t["planner_pick"] == "4,2"
+    # the pick is host/calibration dependent (regenerating the artifact
+    # after a cost-model change can legitimately flip 4,2 <-> 2,4); it must
+    # simply be one of the configs the A/B actually timed (ADVICE r5)
+    timed = {k.split(":", 1)[1] for k in t["configs"] if ":" in k} | {"1"}
+    assert t["planner_pick"] in timed, (t["planner_pick"], sorted(timed))
     assert isinstance(t["hierarchy_win"], bool)
     if not t["hierarchy_win"]:
         # honesty requirement: a losing hierarchy must carry the analysis

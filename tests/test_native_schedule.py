@@ -88,6 +88,7 @@ def test_native_plans_match_python_random_topologies():
     """Hypothesis cross-validation: the C++ twin must agree with the Python
     schedule generator on EVERY rank of arbitrary random topologies, not
     just the hand-picked SHAPES above."""
+    pytest.importorskip("hypothesis", reason="property fuzzing needs hypothesis")
     from hypothesis import given, settings
 
     from conftest import topology_strategy

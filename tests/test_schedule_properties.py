@@ -19,6 +19,11 @@ bugs would silently corrupt gradients.
 from __future__ import annotations
 
 import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property fuzzing needs hypothesis"
+)
 from hypothesis import given, settings, strategies as st
 
 from flextree_tpu.backends import simulate_allreduce, simulate_ring_allreduce

@@ -206,3 +206,34 @@ def test_train_step_ulysses_matches_single_device():
         jax.tree.leaves(jax.device_get(s1["params"])),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_ulysses_forwards_flash_kwargs_to_inner_attention():
+    """ADVICE r5: tuned flash opts must reach the inner local_attention —
+    pinned via the reference impl, which rejects them with local_attention's
+    own TypeError (an unforwarded kwarg would die at ulysses' signature
+    with a different message)."""
+    mesh = jax.make_mesh((4,), ("sp",))
+    q, k, v = _qkv()
+    fn = jax.shard_map(
+        lambda q, k, v: ulysses_attention(
+            q, k, v, "sp", impl="reference", block_q=64
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"),
+    )
+    with pytest.raises(TypeError, match="no flash kwargs"):
+        fn(q, k, v)
+
+
+def test_attn_opts_require_flash_impl():
+    """ADVICE r5: attn_opts with a non-flash attn_impl used to be silently
+    dropped — a tuned config running with library defaults.  Now it raises."""
+    cfg = TransformerConfig(
+        vocab_size=16, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        attn_impl="reference", attn_opts=(("block_q", 64),),
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="attn_impl='flash'"):
+        forward(params, jnp.zeros((1, 8), jnp.int32), cfg)

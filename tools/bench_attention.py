@@ -67,7 +67,9 @@ def main() -> int:
         "stock_tuned_1024_512": dict(impl="stock", block_q=1024, block_k=512),
         "stock_default_shape_512": dict(impl="stock", block_q=512, block_k=512),
         "xla_full_matrix": dict(impl="reference"),
-        "ours_grad_256_512": dict(
+        # the variant is in the name (like the forward rows) so cross-round
+        # artifact comparisons can't silently change meaning (ADVICE r5)
+        "ours_grad_loop_256_512": dict(
             impl="flash", block_q=256, block_k=512, mode="grad", variant="loop"
         ),
         "stock_grad_1024_512": dict(
@@ -94,7 +96,7 @@ def main() -> int:
         if t and (ours is None or t > ours):
             winner_name, ours = k, t
     stock = entries.get("stock_tuned_1024_512", {}).get("tflops")
-    ours_g = entries.get("ours_grad_256_512", {}).get("tflops")
+    ours_g = entries.get("ours_grad_loop_256_512", {}).get("tflops")
     stock_g = max(
         (entries.get(k, {}).get("tflops") or 0.0
          for k in ("stock_grad_1024_512", "stock_grad_512_512")),

@@ -54,7 +54,9 @@ def child_main() -> int:
     # CPU pinning must precede any backend touch; gloo is the CPU
     # cross-process collective transport (the MPI-of-this-world)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+    from flextree_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(LOCAL_DEVICES)
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     import numpy as np
