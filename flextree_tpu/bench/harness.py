@@ -52,6 +52,10 @@ __all__ = [
     "run_attention_bench",
     "autotune_attention",
     "chip_peak_tflops",
+    "GradSyncBenchConfig",
+    "run_grad_sync_bench",
+    "TrainStepBenchConfig",
+    "run_train_step_bench",
 ]
 
 log = get_logger("flextree.bench")
@@ -226,6 +230,291 @@ def run_allreduce_bench(cfg: BenchConfig) -> BenchReport:
         log.info("wrote %s", path)
 
     return BenchReport(cfg, n, str(topo), result, bus, correct, path)
+
+
+# ---------------------------------------------------------- gradient sync
+
+
+@dataclass(frozen=True)
+class GradSyncBenchConfig:
+    """A/B the bucketed/fused gradient sync against per-leaf sync.
+
+    ``n_leaves`` leaves of ``leaf_size`` float32 elements model a
+    transformer's small-leaf tail (the many-small-leaves regime where
+    per-leaf sync pays k x the per-dispatch overhead); ``n_leaves=1`` with
+    a large ``leaf_size`` is the single-large-tensor regime where fusion
+    must be a no-op cost-wise.
+    """
+
+    n_leaves: int = 48
+    leaf_size: int = 16384  # float32 elements per leaf
+    devices: int | None = None
+    topo: str | None = None  # FT_TOPO-style; None -> env/flat
+    repeat: int = 10
+    chunks: int = 2  # the ours_chunked row's pipelining factor
+    bucket_bytes: int | None = None  # None -> planner-derived
+
+
+def run_grad_sync_bench(cfg: GradSyncBenchConfig) -> dict:
+    """Rows: ``per_leaf`` (the historical sync), ``ours_fused`` (bucketed),
+    ``ours_chunked`` (bucketed + chunk-pipelined) — min/avg ms each, the
+    fused rows' speedup vs per-leaf, and a bitwise-identity check between
+    the per-leaf and fused outputs (the sync's hard contract)."""
+    from ..parallel.bucketing import plan_buckets
+    from ..parallel.train import resolve_axis_topos, sync_grads
+
+    n = cfg.devices or len(jax.devices())
+    mesh = flat_mesh(n, "dp")
+    topos = resolve_axis_topos(mesh, ("dp",), cfg.topo)
+    rng = np.random.default_rng(0)
+    tree = {
+        f"leaf{i}": jnp.asarray(
+            rng.standard_normal((n, cfg.leaf_size)).astype(np.float32)
+        )
+        for i in range(cfg.n_leaves)
+    }
+    dev_specs = {k: P() for k in tree}  # every leaf replicated -> synced
+    io_specs = {k: P("dp") for k in tree}
+
+    def make_fn(bucket_bytes, chunks):
+        def f(t):
+            rows = {k: v[0] for k, v in t.items()}
+            out = sync_grads(
+                rows, dev_specs, ("dp",), topos,
+                bucket_bytes=bucket_bytes, chunks=chunks,
+            )
+            return {k: v[None] for k, v in out.items()}
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(io_specs,), out_specs=io_specs,
+                check_vma=False,
+            )
+        )
+
+    variants = {
+        "per_leaf": make_fn(0, 1),
+        "ours_fused": make_fn(cfg.bucket_bytes, 1),
+        "ours_chunked": make_fn(cfg.bucket_bytes, cfg.chunks),
+    }
+    outs = {
+        name: jax.block_until_ready(fn(tree))  # also warms the jit
+        for name, fn in variants.items()
+    }
+    rows = _interleaved_times(
+        {name: (fn, (tree,)) for name, fn in variants.items()}, cfg.repeat
+    )
+    for name in ("ours_fused", "ours_chunked"):
+        rows[name]["vs_per_leaf"] = rows["per_leaf"]["min_ms"] / rows[name]["min_ms"]
+
+    identical = all(
+        np.asarray(outs["per_leaf"][k]).tobytes()
+        == np.asarray(outs["ours_fused"][k]).tobytes()
+        == np.asarray(outs["ours_chunked"][k]).tobytes()
+        for k in tree
+    )
+    if not identical:
+        raise RuntimeError("fused sync output diverged from per-leaf (bitwise)")
+    buckets = plan_buckets(
+        [v[0] for v in tree.values()], [P()] * cfg.n_leaves, ("dp",),
+        topos=topos, axis_sizes={"dp": n}, bucket_bytes=cfg.bucket_bytes,
+    )
+    total_mb = cfg.n_leaves * cfg.leaf_size * 4 / 2**20
+    log.info(
+        "grad sync %d leaves x %d f32 (%.1f MB, %d buckets): per_leaf %.2f ms,"
+        " fused %.2f ms (%.2fx), chunked %.2f ms (%.2fx)",
+        cfg.n_leaves, cfg.leaf_size, total_mb, len(buckets),
+        rows["per_leaf"]["min_ms"],
+        rows["ours_fused"]["min_ms"], rows["ours_fused"]["vs_per_leaf"],
+        rows["ours_chunked"]["min_ms"], rows["ours_chunked"]["vs_per_leaf"],
+    )
+    return {
+        "config": dataclasses.asdict(cfg),
+        "num_devices": n,
+        "topo": str(Topology.resolve(n, cfg.topo)),
+        "total_mb": total_mb,
+        "n_buckets": len(buckets),
+        "identical": identical,
+        "rows": rows,
+    }
+
+
+def _interleaved_times(calls: dict, repeat: int) -> dict:
+    """Per-variant min/avg ms with the timed reps INTERLEAVED per round in
+    a (deterministically) shuffled order instead of back-to-back blocks: on
+    the timeshared 1-core bench host a sustained contention episode
+    otherwise lands entirely on one variant and swings the A/B ratio ~20%
+    run-to-run (the BENCH_ALLREDUCE r03/r04 lesson, same fix as bench.py's
+    CPU A/B), and a FIXED round-robin order adds a position bias — each
+    variant always inherits the cache state its fixed predecessor leaves
+    behind.  ``calls`` maps name -> (jitted_fn, args); every fn must
+    already be compiled/warm."""
+    import random
+
+    from ..utils.timing import Timer
+
+    order = list(calls)
+    shuffler = random.Random(0)
+    times: dict[str, list[float]] = {name: [] for name in calls}
+    for _ in range(repeat):
+        shuffler.shuffle(order)
+        for name in order:
+            fn, fargs = calls[name]
+            t = Timer()
+            jax.block_until_ready(fn(*fargs))
+            times[name].append(t.stop())
+    return {
+        name: {
+            "min_ms": min(ts) * 1e3,
+            "avg_ms": sum(ts) / len(ts) * 1e3,
+        }
+        for name, ts in times.items()
+    }
+
+
+@dataclass(frozen=True)
+class TrainStepBenchConfig:
+    """End-to-end ``train_step_ms``: the full jitted train step (forward +
+    backward + sync + AdamW) under per-leaf vs fused vs chunked gradient
+    sync.  The default model is the many-small-leaves regime (50 gradient
+    leaves, most under 20 KB) on a pure-dp mesh."""
+
+    n_layers: int = 6
+    d_model: int = 64
+    d_ff: int = 128
+    n_heads: int = 4
+    vocab_size: int = 256
+    batch: int = 8
+    seq_len: int = 64
+    devices: int | None = None
+    topo: str | None = None  # grad_topo for the sync
+    repeat: int = 5
+    chunks: int = 2
+
+
+def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
+    """Rows of ``train_step_ms`` (min/avg) per sync strategy, plus a
+    comm-vs-compute attribution: ``sync_ms`` times the gradient sync alone
+    on the model's real gradient tree (the per-bucket ``comm_span`` scopes
+    mark the same collectives in profiler traces), so
+    ``step - sync = compute`` is readable per row.  Also asserts the fused
+    step's updated parameters are bitwise-identical to the per-leaf step's.
+    """
+    from ..models.transformer import TransformerConfig
+    from ..parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_nd,
+        make_train_step,
+        resolve_axis_topos,
+        state_specs,
+        sync_grads,
+    )
+
+    n = cfg.devices or len(jax.devices())
+    mesh = make_mesh_nd(n, (n, 1, 1), ("dp", "sp", "tp"))
+    model_cfg = TransformerConfig(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model, n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+    )
+    state = init_train_state(jax.random.PRNGKey(0), model_cfg)
+    n_leaves = len(jax.tree.leaves(state["params"]))
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (cfg.batch, cfg.seq_len)), jnp.int32
+    )
+    tgts = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (cfg.batch, cfg.seq_len)), jnp.int32
+    )
+
+    train_cfgs = {
+        "per_leaf": TrainConfig(grad_topo=cfg.topo, bucket_bytes=0),
+        "ours_fused": TrainConfig(grad_topo=cfg.topo),
+        "ours_chunked": TrainConfig(grad_topo=cfg.topo, grad_chunks=cfg.chunks),
+    }
+
+    # comm attribution: the sync alone, on gradient-shaped data
+    pspecs = state_specs(model_cfg, "tp")["params"]
+    topos = resolve_axis_topos(mesh, ("dp", "sp", "tp"), cfg.topo)
+    grads = jax.tree.map(
+        lambda p: jnp.asarray(
+            np.random.default_rng(2).standard_normal(p.shape).astype(np.float32)
+        ),
+        state["params"],
+    )
+
+    def make_sync(tc: TrainConfig):
+        def f(g):
+            return sync_grads(
+                g, pspecs, ("dp", "sp", "tp"), topos,
+                bucket_bytes=tc.bucket_bytes, chunks=tc.grad_chunks,
+            )
+
+        rep = jax.tree.map(lambda _: P(), pspecs)
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=mesh, in_specs=(rep,), out_specs=rep, check_vma=False
+            )
+        )
+
+    steps, syncs, states_out = {}, {}, {}
+    for name, tc in train_cfgs.items():
+        steps[name] = make_train_step(mesh, model_cfg, tc)
+        states_out[name], _ = jax.block_until_ready(steps[name](state, toks, tgts))
+        syncs[name] = make_sync(tc)
+        jax.block_until_ready(syncs[name](grads))
+    step_times = _interleaved_times(
+        {n: (fn, (state, toks, tgts)) for n, fn in steps.items()}, cfg.repeat
+    )
+    sync_times = _interleaved_times(
+        {n: (fn, (grads,)) for n, fn in syncs.items()}, cfg.repeat
+    )
+    rows = {}
+    for name in train_cfgs:
+        rows[name] = {
+            "train_step_ms": step_times[name]["min_ms"],
+            "train_step_avg_ms": step_times[name]["avg_ms"],
+            "sync_ms": sync_times[name]["min_ms"],
+            "compute_ms": max(
+                step_times[name]["min_ms"] - sync_times[name]["min_ms"], 0.0
+            ),
+        }
+    for name in ("ours_fused", "ours_chunked"):
+        rows[name]["vs_per_leaf"] = (
+            rows["per_leaf"]["train_step_ms"] / rows[name]["train_step_ms"]
+        )
+
+    identical = True
+    for name in ("ours_fused", "ours_chunked"):
+        same = all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(
+                jax.tree.leaves(states_out["per_leaf"]["params"]),
+                jax.tree.leaves(states_out[name]["params"]),
+            )
+        )
+        if not same:
+            raise RuntimeError(
+                f"{name} train step diverged from per-leaf (bitwise)"
+            )
+        identical = identical and same
+    log.info(
+        "train step (%d leaves): per_leaf %.2f ms, fused %.2f ms (%.2fx), "
+        "chunked %.2f ms (%.2fx); sync %.2f -> %.2f ms",
+        n_leaves,
+        rows["per_leaf"]["train_step_ms"],
+        rows["ours_fused"]["train_step_ms"], rows["ours_fused"]["vs_per_leaf"],
+        rows["ours_chunked"]["train_step_ms"],
+        rows["ours_chunked"]["vs_per_leaf"],
+        rows["per_leaf"]["sync_ms"], rows["ours_fused"]["sync_ms"],
+    )
+    return {
+        "config": dataclasses.asdict(cfg),
+        "num_devices": n,
+        "n_grad_leaves": n_leaves,
+        "identical": identical,
+        "rows": rows,
+    }
 
 
 # ---------------------------------------------------------------- attention

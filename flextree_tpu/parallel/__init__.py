@@ -26,6 +26,13 @@ from .launch import (
     plan_for_mesh,
     topology_for_hybrid,
 )
+from .bucketing import (
+    Bucket,
+    bucketed_sync_grads,
+    plan_buckets,
+    replication_key,
+    spec_axes,
+)
 from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
 from .ring_attention import attention_reference, local_attention, ring_attention
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention
@@ -74,6 +81,11 @@ __all__ = [
     "schedule_lr",
     "global_grad_norm",
     "clip_by_global_norm",
+    "Bucket",
+    "plan_buckets",
+    "bucketed_sync_grads",
+    "replication_key",
+    "spec_axes",
 ]
 
 # Lazy (PEP 562): .train/.pipeline import ..models.transformer, which

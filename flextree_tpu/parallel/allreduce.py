@@ -117,7 +117,7 @@ def _small_dense_allreduce(t, axis_name, rop: ReduceOp):
 # --------------------------------------------------------------------------
 
 
-def allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
+def allreduce(x: jax.Array, axis_name, topo=None, op="sum", chunks: int = 1) -> jax.Array:
     """Topology-parameterized allreduce of ``x`` over ``axis_name``.
 
     Drop-in for ``jax.lax.psum(x, axis_name)`` (when ``op='sum'``) inside
@@ -127,6 +127,11 @@ def allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
     world sizes return immediately (``mpi_mod.hpp:1181-1188``), the ring
     sentinel selects the ring algorithm (``:1194``), otherwise the k-ary
     tree runs.
+
+    ``chunks > 1`` selects the chunk-pipelined execution mode for tree
+    shapes (see :func:`tree_allreduce`); the ring is already pipelined at
+    block granularity and the lonely buddy fold is not separable, so both
+    ignore ``chunks``.
     """
     n = lax.axis_size(axis_name)
     rop = get_op(op)
@@ -138,7 +143,7 @@ def allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
         return lonely_allreduce(x, axis_name, topo, op=rop)
     if topo.is_ring:
         return ring_allreduce(x, axis_name, op=rop)
-    return tree_allreduce(x, axis_name, topo, op=rop)
+    return tree_allreduce(x, axis_name, topo, op=rop, chunks=chunks)
 
 
 # --------------------------------------------------------------------------
@@ -146,12 +151,35 @@ def allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
 # --------------------------------------------------------------------------
 
 
-def tree_allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
+def _chunk_sizes(total: int, n: int, chunks: int) -> list[int]:
+    """Split ``total`` (a multiple of ``n``) into at most ``chunks`` contiguous
+    pieces, each a multiple of ``n``, sizes as balanced as possible."""
+    blocks = total // n
+    c = max(1, min(chunks, blocks))
+    base, rem = divmod(blocks, c)
+    return [(base + (1 if i < rem else 0)) * n for i in range(c)]
+
+
+def tree_allreduce(
+    x: jax.Array, axis_name, topo=None, op="sum", chunks: int = 1
+) -> jax.Array:
     """Hierarchical allreduce with per-stage widths ``topo.widths``.
 
     Non-divisible element counts run as an unpadded scheduled collective on
     the divisible head plus one tiny dense collective on the <N-element
     tail (``_split_main_tail``) — no full-buffer pad/slice copies.
+
+    ``chunks > 1`` enables the **chunk-pipelined** execution mode: the
+    divisible head is split into at most ``chunks`` contiguous pieces (each
+    a multiple of N) and the stage schedule is interleaved so chunk ``c``'s
+    phase-2 allgather is traced between chunk ``c+1``'s phase-1
+    reduce-scatter and its own — the reference overlaps phases with
+    nonblocking MPI progress (``mpi_mod.hpp:988-1060``); here the chunks
+    carry no data dependency on each other, so the interleaving hands XLA
+    the same slack to overlap an allgather with the next reduce-scatter
+    inside one jitted program.  Chunk boundaries sit at multiples of N and
+    every stage collective is elementwise across ranks, so the result is
+    bitwise-identical to the unchunked schedule for ``op='sum'``.
     """
     n = lax.axis_size(axis_name)
     rop = get_op(op)
@@ -163,9 +191,26 @@ def tree_allreduce(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
     head, tail = _split_main_tail(x, n)
     parts = []
     if head is not None:
-        h = _tree_reduce_scatter(head, axis_name, topo, rop)
-        h = _tree_allgather(h, axis_name, topo)
-        parts.append(h)
+        sizes = _chunk_sizes(head.size, n, chunks)
+        if len(sizes) == 1:
+            h = _tree_reduce_scatter(head, axis_name, topo, rop)
+            parts.append(_tree_allgather(h, axis_name, topo))
+        else:
+            pieces, off = [], 0
+            for s in sizes:
+                pieces.append(head[off : off + s])
+                off += s
+            outs, scattered = [], None
+            for c, piece in enumerate(pieces):
+                with jax.named_scope(f"ft_chunk{c}_rs"):
+                    cur = _tree_reduce_scatter(piece, axis_name, topo, rop)
+                if scattered is not None:
+                    with jax.named_scope(f"ft_chunk{c - 1}_ag"):
+                        outs.append(_tree_allgather(scattered, axis_name, topo))
+                scattered = cur
+            with jax.named_scope(f"ft_chunk{len(pieces) - 1}_ag"):
+                outs.append(_tree_allgather(scattered, axis_name, topo))
+            parts.append(jnp.concatenate(outs))
     if tail is not None:
         parts.append(_small_dense_allreduce(tail, axis_name, rop))
     v = parts[0] if len(parts) == 1 else jnp.concatenate(parts)

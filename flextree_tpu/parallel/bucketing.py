@@ -1,0 +1,339 @@
+"""Gradient bucketing/fusion for the FlexTree gradient sync.
+
+The reference's whole value proposition is amortizing per-message latency
+across the fabric (``cost_model/CostModel.h``), yet a transformer gradient
+tree hands the sync dozens of tiny bias/layernorm leaves — and every leaf
+synced alone pays the full per-stage launch+latency term (measured ~3.6 ms
+per extra dispatch on the bench host, WINS.md).  The standard fix is
+message fusion: pack leaves into a few flat buckets and run ONE scheduled
+collective per bucket — k small leaves pay ``k * (launch + latency)``
+per-leaf, one fused bucket pays it once.  The α-β decomposition behind the
+bucket-size choice is the time-cost model of arXiv:2409.04202; the size
+itself comes from the calibrated planner (``planner.choose_bucket_bytes``),
+not a magic constant.
+
+Grouping: leaves fuse only when they agree on **(replication-axis-set,
+dtype)** — the axis set because each bucket runs exactly one allreduce
+sequence (a leaf synced over ``(dp, sp)`` cannot share a buffer with one
+synced over ``(dp, sp, tp)``), the dtype because the flat buffer has one.
+:func:`replication_key` is the shared helper both this module and
+``train.global_grad_norm``'s axis-set grouping use.
+
+**Bitwise identity** with the per-leaf sync is a hard design constraint
+(the per-leaf path stays as the A/B oracle): it holds because, per mesh
+axis, every element keeps the exact cross-rank reduction association it
+had per-leaf:
+
+- *tree/flat stages* (``psum_scatter``/``all_gather``) reduce elementwise
+  across a rank group — the association is position-independent, so
+  packing leaves into one buffer cannot change any element's value;
+- *tails*: each leaf's <N-element remainder is fused into ONE dense
+  ``psum`` per bucket (vs one per leaf) — ``psum`` is elementwise, so
+  fusing tails is also value-preserving, and which elements are tail
+  elements is decided per leaf exactly as ``_split_main_tail`` does;
+- *ring*: the ring's accumulation order for an element depends on its
+  block index ``b = pos // (size // N)``, so naive concatenation WOULD
+  change values.  Ring buckets therefore pack **block-interleaved** —
+  fused block ``b`` is the concatenation of every leaf's block ``b`` —
+  which preserves each element's block index and hence its association.
+
+Lonely (``m+l``) topologies interleave a positional buddy fold with the
+ppermute-ring stage machinery and are not position-independent in any
+packing; buckets fall back to per-leaf sync there (lonely shapes exist for
+awkward world sizes, not for throughput — WINS.md).  The bucketed path is
+sum-only, which is all a gradient sync needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..schedule.stages import LonelyTopology, Topology
+from ..utils.profiling import comm_span
+from .allreduce import _NATIVE_PSUM, allreduce, ring_allreduce, tree_allreduce
+
+__all__ = [
+    "spec_axes",
+    "replication_key",
+    "Bucket",
+    "plan_buckets",
+    "bucketed_sync_grads",
+    "DEFAULT_MAX_BUCKET_BYTES",
+    "CPU_MAX_BUCKET_BYTES",
+]
+
+#: Memory cap on a fused flat buffer when the planner-derived size is used —
+#: a bucket materializes one packed copy of its leaves, so an unbounded
+#: bucket would double peak gradient memory for the largest group.
+DEFAULT_MAX_BUCKET_BYTES = 64 << 20
+
+#: Planner-derived cap on CPU backends.  The alpha-beta chooser only prices
+#: dispatch + bytes, and on the 1-core bench host it lands on one giant
+#: bucket — which measured ~25% SLOWER end-to-end than per-leaf sync inside
+#: the train step, while 64-128 KiB buckets beat per-leaf by ~15%
+#: (BENCH_BUCKETING.json): in-step, the fused pack -> collective -> unpack
+#: -> AdamW chain must stay cache-hot, a locality term the dispatch model
+#: cannot see.  Real accelerators stream collectives from HBM, so the big
+#: DEFAULT_MAX_BUCKET_BYTES stays their cap.
+CPU_MAX_BUCKET_BYTES = 128 << 10
+
+
+def _default_max_bucket_bytes() -> int:
+    """Backend-resolved cap for the planner-derived bucket size (the same
+    per-backend-constants pattern as ``planner.calibrate.default_params``)."""
+    try:
+        backend = jax.default_backend()
+    except Exception:  # no backend initialized (e.g. pure planning tests)
+        backend = "cpu"
+    return CPU_MAX_BUCKET_BYTES if backend == "cpu" else DEFAULT_MAX_BUCKET_BYTES
+
+
+def spec_axes(spec) -> tuple[str, ...]:
+    """Mesh axes a ``PartitionSpec`` *names* (sorted) — the axes the leaf is
+    sharded over.  ``None`` (fully replicated) names no axes."""
+    names: set[str] = set()
+    for entry in tuple(spec) if spec is not None else ():
+        if entry is None:
+            continue
+        names.update(entry if isinstance(entry, (tuple, list)) else (entry,))
+    return tuple(sorted(names))
+
+
+def replication_key(spec, mesh_axes) -> tuple[str, ...]:
+    """Mesh axes a parameter with PartitionSpec ``spec`` is *replicated* on,
+    in ``mesh_axes`` order — the axes its gradient must be allreduced over,
+    and the grouping key for bucketing.  Complement of :func:`spec_axes`
+    within ``mesh_axes``."""
+    used = set(spec_axes(spec))
+    return tuple(a for a in mesh_axes if a not in used)
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One fused sync unit: ``indices`` into the flattened gradient leaves
+    (flat-tree order), all sharing ``axes`` (replication axes to reduce
+    over, mesh order) and ``dtype``."""
+
+    axes: tuple[str, ...]
+    dtype: str
+    indices: tuple[int, ...]
+    nbytes: int
+
+
+def plan_buckets(
+    leaves: Sequence[Any],
+    specs: Sequence[Any],
+    mesh_axes,
+    topos: Mapping[str, Any] | None = None,
+    axis_sizes: Mapping[str, int] | None = None,
+    bucket_bytes: int | None = None,
+    params=None,
+    max_bucket_bytes: int | None = None,
+) -> tuple[Bucket, ...]:
+    """Partition flattened gradient leaves into fused sync buckets.
+
+    ``leaves`` only need ``.size``/``.dtype`` (abstract values work, so HLO
+    tests can plan without materializing).  Leaves group by
+    ``(replication_key, dtype)`` preserving flat order; within a group,
+    consecutive leaves pack greedily until the bucket reaches
+    ``bucket_bytes``.  ``bucket_bytes=None`` derives the size per group from
+    the calibrated cost model (``planner.choose_bucket_bytes`` on the
+    group's own topologies and total bytes, capped at ``max_bucket_bytes``
+    — backend-resolved when None: in-step cache locality caps CPU hosts at
+    ``CPU_MAX_BUCKET_BYTES``, see the constants above); an explicit value
+    is used as-is.  Groups with an empty axis set (leaves sharded over
+    every mesh axis) are skipped — they need no sync.
+    """
+    if max_bucket_bytes is None:
+        max_bucket_bytes = _default_max_bucket_bytes()
+    groups: dict[tuple[tuple[str, ...], str], list[int]] = {}
+    for i, (g, spec) in enumerate(zip(leaves, specs)):
+        axes = replication_key(spec, mesh_axes)
+        if axes and axis_sizes is not None:
+            axes = tuple(a for a in axes if axis_sizes.get(a, 1) > 1)
+        if not axes:
+            continue
+        groups.setdefault((axes, jnp.dtype(g.dtype).name), []).append(i)
+
+    buckets: list[Bucket] = []
+    for (axes, dtype), idxs in groups.items():
+        itemsize = jnp.dtype(dtype).itemsize
+        sizes = [leaves[i].size * itemsize for i in idxs]
+        cap = bucket_bytes
+        if cap is None:
+            cap = _derived_bucket_bytes(
+                sum(sizes), len(idxs), axes, topos or {}, axis_sizes or {},
+                params, max_bucket_bytes,
+            )
+        cap = max(int(cap), 1)
+        cur: list[int] = []
+        cur_bytes = 0
+        for i, nb in zip(idxs, sizes):
+            if cur and cur_bytes + nb > cap:
+                buckets.append(Bucket(axes, dtype, tuple(cur), cur_bytes))
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nb
+        if cur:
+            buckets.append(Bucket(axes, dtype, tuple(cur), cur_bytes))
+    return tuple(buckets)
+
+
+def _derived_bucket_bytes(
+    total_bytes, n_leaves, axes, topos, axis_sizes, params, max_bucket_bytes
+):
+    """Planner-derived bucket size for one (axes, dtype) group: the sync
+    runs one allreduce per axis per bucket, so the launch term the chooser
+    amortizes is the sum of the per-axis fixed costs."""
+    from ..planner.choose import choose_bucket_bytes
+
+    cost_topos = []
+    for ax in axes:
+        n = int(axis_sizes.get(ax, 0)) or None
+        topo = topos.get(ax)
+        if topo is None:  # the "psum" sentinel: one fused native collective
+            if n is None:
+                continue
+            topo = Topology.flat(n)
+        cost_topos.append(Topology.resolve(n or topo.num_nodes, topo))
+    if not cost_topos:
+        return max_bucket_bytes
+    derived = choose_bucket_bytes(
+        total_bytes, cost_topos, n_leaves=n_leaves, params=params
+    )
+    return min(derived, max_bucket_bytes)
+
+
+def _unpack(fused, segments):
+    """Split a fused flat buffer back into per-leaf pieces of ``segments``
+    element counts."""
+    out, off = [], 0
+    for s in segments:
+        out.append(lax.slice_in_dim(fused, off, off + s, axis=0))
+        off += s
+    return out
+
+
+def _fused_native_psum(leaves, axis_name):
+    """Fuse the ``"psum"``-sentinel axis: one native all-reduce per bucket.
+    ``psum`` is elementwise across ranks, so fusion is value-preserving."""
+    flats = [g.reshape(-1) for g in leaves]
+    fused = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+    red = _NATIVE_PSUM(fused, axis_name)
+    return [
+        p.reshape(g.shape) for p, g in zip(_unpack(red, [f.size for f in flats]), leaves)
+    ]
+
+
+def _fused_axis_allreduce(leaves, axis_name, topo, chunks: int = 1):
+    """One FlexTree allreduce over ``axis_name`` for a whole bucket.
+
+    Packs the leaves' divisible heads into one scheduled collective and
+    their <N-element remainders into ONE dense tail collective (vs one per
+    leaf via ``_split_main_tail``), preserving each element's per-leaf
+    reduction association — see the module docstring for why each packing
+    is bitwise-safe.
+    """
+    n = lax.axis_size(axis_name)
+    if n <= 1:
+        return list(leaves)
+    topo = Topology.resolve(n, topo)
+    if isinstance(topo, LonelyTopology):
+        # positional buddy fold: not packing-invariant — per-leaf fallback
+        return [allreduce(g, axis_name, topo=topo, op="sum") for g in leaves]
+    if len(leaves) == 1:
+        return [allreduce(leaves[0], axis_name, topo=topo, op="sum", chunks=chunks)]
+
+    flats = [g.reshape(-1) for g in leaves]
+    mains = [(v.size // n) * n for v in flats]
+    head_ids = [i for i, m in enumerate(mains) if m]
+    tail_ids = [i for i, (v, m) in enumerate(zip(flats, mains)) if v.size > m]
+    heads_out: dict[int, jax.Array] = {}
+    tails_out: dict[int, jax.Array] = {}
+
+    if head_ids:
+        if topo.is_ring:
+            # block-interleaved packing: fused block b = [leaf block b ...],
+            # so each element keeps its ring block index (= association)
+            cols = [flats[i][: mains[i]].reshape(n, -1) for i in head_ids]
+            widths = [c.shape[1] for c in cols]
+            fused = jnp.concatenate(cols, axis=1).reshape(-1)
+            red = ring_allreduce(fused, axis_name, op="sum").reshape(n, -1)
+            off = 0
+            for i, w in zip(head_ids, widths):
+                heads_out[i] = red[:, off : off + w].reshape(-1)
+                off += w
+        else:
+            segs = [flats[i][: mains[i]] for i in head_ids]
+            fused = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+            red = tree_allreduce(fused, axis_name, topo=topo, op="sum", chunks=chunks)
+            for i, piece in zip(head_ids, _unpack(red, [s.size for s in segs])):
+                heads_out[i] = piece
+    if tail_ids:
+        segs = [flats[i][mains[i] :] for i in tail_ids]
+        fused = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        red = _NATIVE_PSUM(fused, axis_name)
+        for i, piece in zip(tail_ids, _unpack(red, [s.size for s in segs])):
+            tails_out[i] = piece
+
+    out = []
+    for i, g in enumerate(leaves):
+        h, t = heads_out.get(i), tails_out.get(i)
+        if h is None and t is None:
+            out.append(g)  # zero-size leaf
+        elif t is None:
+            out.append(h.reshape(g.shape))
+        elif h is None:
+            out.append(t.reshape(g.shape))
+        else:
+            out.append(jnp.concatenate([h, t]).reshape(g.shape))
+    return out
+
+
+def bucketed_sync_grads(
+    grads,
+    pspecs,
+    mesh_axes,
+    topos: Mapping[str, Any],
+    bucket_bytes: int | None = None,
+    chunks: int = 1,
+    params=None,
+):
+    """Bucketed/fused FlexTree gradient sync — the fused twin of
+    ``train.sync_grads`` (collective-context function; call inside
+    ``shard_map``).
+
+    Semantics are identical (sum each leaf over its replication axes, per
+    axis in ``mesh_axes`` order) and the result is bitwise-identical to the
+    per-leaf sync; the collective count drops from leaves x stages to
+    buckets x stages (+ one fused tail per bucket per axis).
+    ``bucket_bytes=None`` derives the size from the calibrated planner;
+    ``chunks > 1`` runs each bucket's tree collectives chunk-pipelined.
+    Per-bucket ``comm_span`` scopes (``ft_bucket*``) mark each bucket's
+    collectives in profiler traces so comm time is attributable per bucket.
+    """
+    flat_g, treedef = jax.tree.flatten(grads)
+    flat_s = treedef.flatten_up_to(pspecs)
+    axis_sizes = {ax: lax.axis_size(ax) for ax in mesh_axes}
+    buckets = plan_buckets(
+        flat_g, flat_s, mesh_axes, topos=topos, axis_sizes=axis_sizes,
+        bucket_bytes=bucket_bytes, params=params,
+    )
+    out = list(flat_g)
+    for bi, b in enumerate(buckets):
+        leaves = [out[i] for i in b.indices]
+        for ax in b.axes:
+            name = f"ft_bucket{bi}_{ax}_{len(b.indices)}leaves_{b.nbytes}B"
+            with comm_span(name):
+                if topos[ax] is None:
+                    leaves = _fused_native_psum(leaves, ax)
+                else:
+                    leaves = _fused_axis_allreduce(leaves, ax, topos[ax], chunks)
+        for i, g in zip(b.indices, leaves):
+            out[i] = g
+    return treedef.unflatten(out)

@@ -128,7 +128,10 @@ def make_moe_train_step(
         )
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads = sync_grads(grads, sspecs["params"], mesh_axes, topos)
+        grads = sync_grads(
+            grads, sspecs["params"], mesh_axes, topos,
+            bucket_bytes=train_cfg.bucket_bytes, chunks=train_cfg.grad_chunks,
+        )
 
         global_ce = ce
         global_aux = aux / n_devices

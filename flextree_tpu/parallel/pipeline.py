@@ -274,7 +274,10 @@ def make_pipeline_train_step(
         loss, grads = jax.value_and_grad(local_loss)(state["params"])
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads = sync_grads(grads, sspecs["params"], mesh_axes, topos)
+        grads = sync_grads(
+            grads, sspecs["params"], mesh_axes, topos,
+            bucket_bytes=train_cfg.bucket_bytes, chunks=train_cfg.grad_chunks,
+        )
         global_loss = loss
         for ax in mesh_axes:
             global_loss = lax.psum(global_loss, ax)

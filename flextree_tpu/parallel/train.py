@@ -51,6 +51,7 @@ from ..models.transformer import (
 )
 from ..schedule.stages import Topology, TopologyError
 from .allreduce import allreduce
+from .bucketing import bucketed_sync_grads, replication_key, spec_axes
 
 __all__ = [
     "TrainConfig",
@@ -94,6 +95,19 @@ class TrainConfig:
     warmup_steps: int = 0
     total_steps: int = 0
     min_lr_frac: float = 0.1
+    # gradient bucketing/fusion (parallel/bucketing.py): the sync packs
+    # gradient leaves grouped by (replication-axis-set, dtype) into fused
+    # flat buckets and runs ONE FlexTree allreduce per bucket — bitwise-
+    # identical to per-leaf, but buckets x stages collectives instead of
+    # leaves x stages.  None (default) -> bucket size derived from the
+    # calibrated planner (planner.choose_bucket_bytes); 0 -> per-leaf sync
+    # (the A/B oracle / escape hatch); > 0 -> explicit bucket-size cap in
+    # bytes.
+    bucket_bytes: int | None = None
+    # chunk-pipelined allreduce: > 1 splits each bucket's tree collective
+    # into C chunks with phase-2/phase-1 interleaving (allreduce chunks=C);
+    # bitwise-identical for the sum sync, 1 = off.
+    grad_chunks: int = 1
 
 
 def prime_factors(n: int) -> list[int]:
@@ -190,19 +204,6 @@ def state_specs(cfg: TransformerConfig, tp_axis: str | None = "tp") -> dict:
     return make_state_specs(param_specs(cfg, tp_axis))
 
 
-def _replication_axes(spec: P, mesh_axes) -> tuple[str, ...]:
-    """Mesh axes a parameter with PartitionSpec ``spec`` is replicated on."""
-    used = set()
-    for entry in spec:
-        if entry is None:
-            continue
-        if isinstance(entry, (tuple, list)):
-            used.update(entry)
-        else:
-            used.add(entry)
-    return tuple(a for a in mesh_axes if a not in used)
-
-
 def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
     """Per-axis FlexTree topology for the gradient sync.
 
@@ -224,21 +225,45 @@ def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
     return {ax: axis_topo(ax) for ax in mesh_axes}
 
 
-def sync_grads(grads, pspecs, mesh_axes, topos: dict):
+def sync_grads(
+    grads,
+    pspecs,
+    mesh_axes,
+    topos: dict,
+    bucket_bytes: int | None = 0,
+    chunks: int = 1,
+):
     """FlexTree gradient sync: sum each leaf over its replication axes.
 
     An axis whose topology is ``None`` (the ``"psum"`` sentinel) uses the
     native all-reduce — the in-step analog of the benchmark's
-    ``--comm-type xla`` baseline."""
+    ``--comm-type xla`` baseline.
+
+    ``bucket_bytes`` selects the execution strategy: ``0`` (default, the
+    historical behavior) syncs per leaf — one allreduce sequence per
+    gradient leaf; any other value routes through the bucketed/fused sync
+    (``parallel.bucketing.bucketed_sync_grads`` — ``None`` derives the
+    bucket size from the calibrated planner, ``> 0`` is an explicit cap),
+    which is bitwise-identical but runs one fused collective per *bucket*.
+    The train-step builders pass their ``TrainConfig.bucket_bytes`` through,
+    so the bucketed path is the production default.  ``chunks > 1`` runs
+    tree collectives chunk-pipelined (both paths).
+    """
     from .allreduce import _NATIVE_PSUM
 
+    if bucket_bytes != 0:
+        return bucketed_sync_grads(
+            grads, pspecs, mesh_axes, topos,
+            bucket_bytes=bucket_bytes, chunks=chunks,
+        )
+
     def sync(g, spec):
-        for ax in _replication_axes(spec, mesh_axes):
+        for ax in replication_key(spec, mesh_axes):
             topo = topos[ax]
             if topo is None:
                 g = _NATIVE_PSUM(g, ax)
             else:
-                g = allreduce(g, ax, topo=topo, op="sum")
+                g = allreduce(g, ax, topo=topo, op="sum", chunks=chunks)
         return g
 
     return jax.tree.map(sync, grads, pspecs, is_leaf=lambda x: x is None)
@@ -287,12 +312,7 @@ def global_grad_norm(grads, pspecs):
     by_axes: dict[tuple, Any] = {}
     for g, spec in zip(flat_g, flat_s):
         sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
-        names: set = set()
-        for entry in tuple(spec) if spec is not None else ():
-            if entry is None:
-                continue
-            names.update(entry if isinstance(entry, tuple) else (entry,))
-        key = tuple(sorted(names))
+        key = spec_axes(spec)
         by_axes[key] = by_axes.get(key, jnp.float32(0.0)) + sq
     total = jnp.float32(0.0)
     for axes, sq in by_axes.items():
@@ -403,7 +423,10 @@ def make_train_step(
         loss, grads = jax.value_and_grad(local_loss)(state["params"])
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads = sync_grads(grads, sspecs["params"], mesh_axes, topos)
+        grads = sync_grads(
+            grads, sspecs["params"], mesh_axes, topos,
+            bucket_bytes=train_cfg.bucket_bytes, chunks=train_cfg.grad_chunks,
+        )
         global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
 
         metrics = {"loss": global_loss}

@@ -35,6 +35,7 @@ from .choose import (
     Candidate,
     Plan,
     candidate_topologies,
+    choose_bucket_bytes,
     choose_topology,
     replan_for_survivors,
 )
@@ -74,6 +75,7 @@ __all__ = [
     "Candidate",
     "Plan",
     "candidate_topologies",
+    "choose_bucket_bytes",
     "choose_topology",
     "replan_for_survivors",
     "count_ordered_factorizations",

@@ -42,6 +42,7 @@ __all__ = [
     "Plan",
     "choose_topology",
     "candidate_topologies",
+    "choose_bucket_bytes",
     "replan_for_survivors",
 ]
 
@@ -251,6 +252,72 @@ def choose_topology(
         topo = Topology(n, best.widths)
 
     return Plan(n, nbytes, topo, tuple(cands), advisory)
+
+
+def choose_bucket_bytes(
+    nbytes: int,
+    topos,
+    *,
+    n_leaves: int | None = None,
+    params: TpuCostParams | None = None,
+    max_buckets: int = 64,
+) -> int:
+    """Cost-model-driven gradient-bucket size: the fused-sync bucket cap
+    that minimizes predicted sync time for ``nbytes`` of gradients.
+
+    With ``k`` buckets the sync pays the per-collective fixed overhead
+    (launch + per-hop latency + control — every byte-independent term of
+    :func:`allreduce_cost`) ``k`` times, while consecutive buckets give the
+    compiler pipelining slack: bucket ``i``'s phase-2 allgather can overlap
+    bucket ``i+1``'s phase-1 reduce-scatter, which at the model level turns
+    the byte-proportional terms from ``B`` into ``B * (k+1) / (2k)`` (the
+    classic α-β chunking tradeoff — arXiv:2409.04202's latency-vs-bandwidth
+    decomposition; perfect overlap halves the exposed byte time as k grows).
+    So
+
+        T(k) = k * fixed + byte_terms(nbytes) * (k + 1) / (2 * k)
+
+    is evaluated for ``k`` in 1..min(max_buckets, n_leaves) and the argmin's
+    ``ceil(nbytes / k)`` is returned.  ``topos`` is one resolved
+    ``Topology`` (or a sequence of them, one per replication axis the sync
+    loops over — the fixed and byte terms then sum across axes).  ``params``
+    defaults to the calibrated constants (``FLEXTREE_CALIBRATION``) like
+    every other chooser entry point; on hosts where calibration measured a
+    large launch overhead the argmin lands on few, large buckets, and on
+    fabrics where bandwidth dominates it shrinks them toward the pipelined
+    regime.  Interior optimum: ``dT/dk = 0`` at ``k* = sqrt(byte/(2*fixed))``.
+    """
+    if nbytes < 0:
+        raise ValueError(f"nbytes must be >= 0, got {nbytes}")
+    if params is None:
+        from .calibrate import default_params
+
+        params = default_params()
+    topo_list = (
+        [topos] if isinstance(topos, (Topology, LonelyTopology)) else list(topos)
+    )
+    if not topo_list:
+        raise ValueError("choose_bucket_bytes needs at least one topology")
+    if nbytes == 0:
+        return 1
+
+    def cost(t, nb):
+        if isinstance(t, LonelyTopology):
+            return lonely_allreduce_cost(t.tree, t.lonely, nb, params)
+        return allreduce_cost(t, nb, params)
+
+    fixed = byte_us = 0.0
+    for t in topo_list:
+        fixed += cost(t, 0).total_us
+        full = cost(t, nbytes)
+        byte_us += full.bandwidth_us + full.reduce_us
+    k_max = max(1, min(max_buckets, n_leaves or max_buckets))
+    best_k, best_t = 1, float("inf")
+    for k in range(1, k_max + 1):
+        t_k = k * fixed + byte_us * (k + 1) / (2 * k)
+        if t_k < best_t:
+            best_k, best_t = k, t_k
+    return -(-nbytes // best_k)  # ceil
 
 
 def replan_for_survivors(

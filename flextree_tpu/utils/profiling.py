@@ -33,7 +33,38 @@ import time
 
 from .logging import get_logger
 
-__all__ = ["trace", "phase_timer", "PhaseTimer", "debug_dump_schedule", "debug_enabled"]
+__all__ = [
+    "trace",
+    "phase_timer",
+    "PhaseTimer",
+    "comm_span",
+    "debug_dump_schedule",
+    "debug_enabled",
+]
+
+
+@contextlib.contextmanager
+def comm_span(name: str, timer: "PhaseTimer | None" = None):
+    """Named communication span: a ``jax.named_scope`` (so the span shows up
+    as a named range over its collectives in profiler traces, exactly like
+    the per-stage ``ft_rs_stage*`` scopes) plus an optional host-side
+    :class:`PhaseTimer` checkpoint on exit.
+
+    This is the per-*bucket* observability layer the fused gradient sync
+    uses (``parallel.bucketing``): each bucket's collectives trace under an
+    ``ft_bucket{i}_{axis}_{k}leaves_{bytes}B`` range, so a profile (or a
+    RUN_REPORT built from one) can attribute comm time per bucket and
+    separate comm from compute per step.  Under ``jit`` the body runs at
+    trace time, so the *timer* measures tracing, not execution — pass a
+    timer only in eager/host-level phases; inside jitted code the named
+    scope is the useful half.
+    """
+    import jax
+
+    with jax.named_scope(name):
+        yield
+    if timer is not None:
+        timer.checkpoint(name)
 
 
 @contextlib.contextmanager

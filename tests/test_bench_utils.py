@@ -177,9 +177,14 @@ class TestBenchPyContract:
         assert len(lines) == 1, p.stdout
         payload = json.loads(lines[0])
         # the 4 contract keys plus the git provenance stamp (the reference's
-        # CMake git stamping, CMakeLists.txt:10-31)
-        assert set(payload) == {"metric", "value", "unit", "vs_baseline", "git"}
+        # CMake git stamping, CMakeLists.txt:10-31); supplementary keys are
+        # allowed on both paths (the TPU path's honesty metrics, the CPU
+        # path's grad-bucketing rows — see bench.py)
+        assert set(payload) >= {"metric", "value", "unit", "vs_baseline", "git"}
         assert payload["metric"] != "bench_error", payload
+        # the bucketing rows are supplementary, but their failure is not: a
+        # broken bench_grad_bucketing must trip CI, not vanish silently
+        assert "bucketing_error" not in payload, payload["bucketing_error"]
         assert payload["value"] > 0
 
 

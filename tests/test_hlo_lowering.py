@@ -108,6 +108,108 @@ def test_generic_op_tree_uses_ring_exchange():
     assert sorted(int(m) for m in msgs) == [8, 16], msgs
 
 
+# ------------------------------------------------- bucketed-sync guard
+
+
+def _collective_counts(ir: str) -> dict:
+    return {
+        "rs": ir.count('"stablehlo.reduce_scatter"'),
+        "ag": ir.count('"stablehlo.all_gather"'),
+        "ar": ir.count('"stablehlo.all_reduce"'),
+        "cp": ir.count('"stablehlo.collective_permute"'),
+    }
+
+
+def test_bucketed_train_step_collectives_bounded_by_buckets():
+    """Regression tripwire against silently falling back to per-leaf sync:
+    the lowered bucketed train step's scheduled-collective count must be
+    bounded by buckets x stages, not leaves x stages.
+
+    The train step's forward/backward have their own collectives (tp
+    psums, loss reductions), identical across sync strategies — so the
+    ``grad_topo="psum"`` lowering (whose FlexTree rs/ag count is zero) is
+    the subtraction baseline isolating the sync's contribution.
+    """
+    from flextree_tpu.models.transformer import TransformerConfig
+    from flextree_tpu.parallel.bucketing import plan_buckets, replication_key
+    from flextree_tpu.parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_nd,
+        make_train_step,
+        state_specs,
+    )
+
+    model_cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    mesh = make_mesh_nd(8, (2, 2, 2), ("dp", "sp", "tp"))
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg), jax.random.PRNGKey(0)
+    )
+    tok = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+
+    def lower(train_cfg):
+        step = make_train_step(mesh, model_cfg, train_cfg)
+        return step.lower(state_sds, tok, tok).as_text()
+
+    per_leaf = _collective_counts(lower(TrainConfig(bucket_bytes=0)))
+    bucketed = _collective_counts(lower(TrainConfig(bucket_bytes=1 << 30)))
+    native = _collective_counts(lower(TrainConfig(grad_topo="psum")))
+
+    # the sync's own scheduled collectives, by subtraction
+    sync_rs_leaf = per_leaf["rs"] - native["rs"]
+    sync_rs_bucket = bucketed["rs"] - native["rs"]
+    sync_ag_leaf = per_leaf["ag"] - native["ag"]
+    sync_ag_bucket = bucketed["ag"] - native["ag"]
+
+    # expected bucket plan: same grouping the sync runs (flat topo per
+    # axis -> 1 stage, so rs count == sum over buckets of their axis count)
+    pspecs = state_specs(model_cfg, "tp")["params"]
+    flat_g, treedef = jax.tree.flatten(state_sds["params"])
+    flat_s = treedef.flatten_up_to(pspecs)
+    axis_sizes = {"dp": 2, "sp": 2, "tp": 2}
+    buckets = plan_buckets(
+        flat_g, flat_s, ("dp", "sp", "tp"),
+        axis_sizes=axis_sizes, bucket_bytes=1 << 30,
+    )
+    expected_bucket_rs = sum(len(b.axes) for b in buckets)
+    n_synced_leaves = sum(
+        1 for s in flat_s if replication_key(s, ("dp", "sp", "tp"))
+    )
+
+    assert sync_rs_bucket == expected_bucket_rs, (sync_rs_bucket, buckets)
+    assert sync_ag_bucket == expected_bucket_rs
+    # the tripwire: per-leaf scales with leaves; bucketed must not
+    assert sync_rs_leaf >= n_synced_leaves > len(buckets)
+    assert sync_rs_bucket < sync_rs_leaf
+    assert sync_ag_bucket < sync_ag_leaf
+    # fused tails: at most one dense collective per (bucket, axis), vs one
+    # per (leaf, axis) on the per-leaf path
+    assert bucketed["ar"] <= per_leaf["ar"]
+
+
+def test_chunked_allreduce_keeps_stage_collective_count():
+    """chunks=C multiplies scheduled collectives by C (one rs+ag pair per
+    chunk per stage) — never more — and introduces no all_to_all."""
+    topo = (4, 2)
+    chunks = 4
+    mesh = flat_mesh(8, "ft")
+
+    def f(row):
+        return tree_allreduce(row[0], "ft", topo, chunks=chunks)[None]
+
+    ir = (
+        jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft")))
+        .lower(jnp.zeros((8, COUNT), jnp.float32))
+        .as_text()
+    )
+    counts = _collective_counts(ir)
+    assert counts["rs"] == chunks * len(topo)
+    assert counts["ag"] == chunks * len(topo)
+    assert "all_to_all" not in ir
+
+
 def test_ring_lowering_is_permute_loop():
     from flextree_tpu.parallel import ring_allreduce
 
