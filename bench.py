@@ -345,6 +345,49 @@ def bench_tpu_kernel_guarded(timeout_s: int = 3300) -> dict | None:
     return None
 
 
+def run_static_analysis_tripwire(timeout_s: int = 120) -> dict:
+    """Supplementary key ``analysis_violations`` — the static verifier's
+    verdict on this exact tree (ISSUE 3 tripwire; 0 = clean).
+
+    Runs the full CLI (``flextree_tpu.analysis``) in a subprocess: it
+    pins its own 8-vdev CPU mesh (safe regardless of this process's
+    backend state) and a wedged run must never hang the driver.  An
+    analyzer that fails to run is itself a tripwire condition, reported
+    as ``analysis_error`` with the key absent — absent reads as "not
+    verified", never as "clean".
+    """
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable, "-m", "flextree_tpu.analysis",
+                "--report", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            report = json.load(fh)
+        out = {"analysis_violations": report["analysis_violations"]}
+        if not report["mutation_selftest"]["all_caught"]:
+            out["analysis_error"] = "mutation self-test escaped"
+        elif p.returncode != 0 and report["analysis_violations"] == 0:
+            # rc=1 WITH violations is the analyzer doing its job (the count
+            # above carries the verdict); rc!=0 with a clean report means
+            # the analyzer itself malfunctioned
+            out["analysis_error"] = f"analysis CLI rc={p.returncode}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"analysis_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def main() -> int:
     if "--tpu-child" in sys.argv:
         # child mode: the actual TPU bench, unguarded (parent holds the
@@ -371,6 +414,8 @@ def main() -> int:
         result.setdefault("git", build_info()["git_describe"])
     except Exception:
         pass
+    if result.get("metric") != "bench_error":
+        result.update(run_static_analysis_tripwire())
     print(json.dumps(result))
     return 0
 

@@ -1,0 +1,36 @@
+"""FlexTree static verifier: ahead-of-time analysis of generated programs.
+
+Three layers, one report:
+
+1. :mod:`.schedule_check` — model-check generated message programs
+   (tree/ring/lonely × chunked): deadlock-freedom under blocking
+   rendezvous, chunk conservation, peer symmetry, chunk-buffer overlap.
+2. :mod:`.hlo_lint` — lower the jitted entrypoints and lint the StableHLO
+   against declared collective budgets, dtype, host-transfer, and
+   donation contracts.
+3. :mod:`.jit_hygiene` — AST lint over the library source for
+   wall-clock/RNG calls inside jitted code, Python branching on traced
+   values, and missing ``static_argnames``.
+
+The suite is self-distrusting: :mod:`.mutation` seeds known corruption
+classes and asserts each is caught — a checker that passes everything is
+a failing test.  CLI: ``python -m flextree_tpu.analysis --report
+ANALYSIS.json``; CI gate: ``tools/run_static_checks.py``.
+"""
+
+from .base import Violation, violations_to_json
+from .schedule_check import (
+    build_program,
+    check_program,
+    check_schedule,
+    check_standard_schedules,
+)
+
+__all__ = [
+    "Violation",
+    "violations_to_json",
+    "build_program",
+    "check_program",
+    "check_schedule",
+    "check_standard_schedules",
+]

@@ -1,0 +1,522 @@
+"""Layer 2: HLO linter — lower the jitted entrypoints and hold the
+StableHLO to declared budgets.
+
+``tests/test_hlo_lowering.py`` pins a handful of lowering facts with
+one-off asserts; this layer generalizes them into a declarative contract:
+every entrypoint (allreduce variants, the bucketed train step, the MoE and
+pipeline steps) carries an :class:`HloBudget` stating what its compiled
+program may contain —
+
+- **collective counts**: scheduled collectives scale with buckets and
+  stages, never with gradient leaves; chunked schedules multiply by the
+  chunk count, never more;
+- **op classes**: no ``all_to_all`` outside the entrypoints that earn it
+  (Ulysses, MoE dispatch), no host transfers
+  (``send``/``recv``/``infeed``/``outfeed``) anywhere;
+- **dtype**: collectives on the bf16 path carry bf16 operands — a silent
+  f32 upcast doubles wire bytes and is exactly the kind of regression a
+  refactor introduces without failing any numeric test;
+- **donation**: entrypoints jitted with donated buffers actually lower
+  with ``jax.buffer_donor`` so XLA may alias (a dropped donation doubles
+  peak memory, again numerically invisible).
+
+Everything works on ``jax.jit(...).lower().as_text()`` — tracing plus
+StableHLO emission, no XLA compile — so the whole layer runs in seconds
+on the CPU host.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from .base import Violation
+
+__all__ = [
+    "HloBudget",
+    "collective_counts",
+    "collective_operand_dtypes",
+    "lint_ir",
+    "lower_entrypoints",
+    "run_hlo_lint",
+]
+
+#: StableHLO ops that move data between host and device — never expected
+#: in any FlexTree program (the whole point is staying on-fabric).
+HOST_TRANSFER_OPS = (
+    "stablehlo.send",
+    "stablehlo.recv",
+    "stablehlo.infeed",
+    "stablehlo.outfeed",
+)
+
+COLLECTIVE_OPS = (
+    "reduce_scatter",
+    "all_gather",
+    "all_reduce",
+    "collective_permute",
+    "all_to_all",
+)
+
+
+@dataclass(frozen=True)
+class HloBudget:
+    """Declared contract for one lowered entrypoint.  ``None`` = unchecked;
+    counts are exact-or-max depending on ``exact`` (exact catches both
+    regressions *and* silently-vanished collectives)."""
+
+    reduce_scatter: int | None = None
+    all_gather: int | None = None
+    all_reduce: int | None = None
+    collective_permute: int | None = None
+    all_to_all: int | None = 0
+    exact: bool = True
+    #: allowed element types on collective operands (None = unchecked)
+    collective_dtypes: tuple[str, ...] | None = None
+    #: require at least one donated input to survive lowering
+    require_donation: bool = False
+    note: str = ""
+
+
+def collective_counts(ir: str) -> dict[str, int]:
+    return {op: ir.count(f'"stablehlo.{op}"') for op in COLLECTIVE_OPS}
+
+
+def collective_operand_dtypes(ir: str) -> dict[str, list[str]]:
+    """Element type of each collective op's operand, parsed from the
+    ``: (tensor<...xTY>, ...) -> ...`` suffix of its line.  The attribute
+    dict mid-line contains nested ``<...>`` (channel handles), so only the
+    trailing operand-type list is parsed — same lesson as
+    ``tests/test_hlo_lowering.py``."""
+    out: dict[str, list[str]] = {op: [] for op in COLLECTIVE_OPS}
+    for line in ir.splitlines():
+        for op in COLLECTIVE_OPS:
+            if f'"stablehlo.{op}"' not in line:
+                continue
+            m = re.search(r":\s*\(tensor<([^>]*?)>", line)
+            if m:
+                elem = m.group(1).split("x")[-1]
+                out[op].append(elem)
+    return out
+
+
+def lint_ir(name: str, ir: str, budget: HloBudget) -> list[Violation]:
+    out: list[Violation] = []
+    counts = collective_counts(ir)
+    for op in COLLECTIVE_OPS:
+        want = getattr(budget, op)
+        if want is None:
+            continue
+        got = counts[op]
+        bad = got != want if budget.exact else got > want
+        if bad:
+            rel = "!=" if budget.exact else ">"
+            out.append(
+                Violation(
+                    "hlo",
+                    "budget",
+                    name,
+                    f"{got} stablehlo.{op} ops {rel} budget {want}"
+                    + (f" ({budget.note})" if budget.note else ""),
+                )
+            )
+    for op in HOST_TRANSFER_OPS:
+        if f'"{op}"' in ir:
+            out.append(
+                Violation(
+                    "hlo",
+                    "host-transfer",
+                    name,
+                    f"unexpected {op}: program round-trips through the host",
+                )
+            )
+    if budget.collective_dtypes is not None:
+        for op, dtypes in collective_operand_dtypes(ir).items():
+            for dt in dtypes:
+                if dt not in budget.collective_dtypes:
+                    out.append(
+                        Violation(
+                            "hlo",
+                            "dtype-drift",
+                            name,
+                            f"stablehlo.{op} operates on {dt}, allowed "
+                            f"{budget.collective_dtypes}: a silent upcast "
+                            f"multiplies wire bytes",
+                        )
+                    )
+                    break
+    if budget.require_donation and "jax.buffer_donor" not in ir:
+        out.append(
+            Violation(
+                "hlo",
+                "donation",
+                name,
+                "no jax.buffer_donor attribute survived lowering: the "
+                "donated input is being copied, doubling peak memory",
+            )
+        )
+    return out
+
+
+# ----------------------------------------------------------- entrypoints
+
+
+def _require_devices(n: int = 8) -> None:
+    import jax
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"hlo lint needs {n} (virtual) devices, found "
+            f"{len(jax.devices())} — run under the analysis CLI or the "
+            f"test harness, which pin 8 virtual CPU devices"
+        )
+
+
+def _lower_allreduce(topo, op="sum", dtype=None, chunks=1, donate=False) -> str:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import tree_allreduce
+    from ..parallel.mesh import flat_mesh
+
+    if dtype is None:
+        dtype = jnp.float32 if op == "sum" else jnp.int32
+    mesh = flat_mesh(8, "ft")
+
+    def f(row):
+        return tree_allreduce(row[0], "ft", topo, op=op, chunks=chunks)[None]
+
+    fn = jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"))
+    jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+    return jitted.lower(jnp.zeros((8, 64), dtype)).as_text()
+
+
+def _lower_ring(dtype=None) -> str:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import ring_allreduce
+    from ..parallel.mesh import flat_mesh
+
+    mesh = flat_mesh(8, "ft")
+
+    def f(row):
+        return ring_allreduce(row[0], "ft")[None]
+
+    fn = jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"))
+    return jax.jit(fn).lower(jnp.zeros((8, 64), dtype or jnp.float32)).as_text()
+
+
+def _small_model_cfg():
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+
+
+def _lower_train_step(bucket_bytes) -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_nd,
+        make_train_step,
+    )
+
+    model_cfg = _small_model_cfg()
+    mesh = make_mesh_nd(8, (2, 2, 2), ("dp", "sp", "tp"))
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg), jax.random.PRNGKey(0)
+    )
+    tok = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+    step = make_train_step(
+        mesh, model_cfg, TrainConfig(bucket_bytes=bucket_bytes)
+    )
+    return step.lower(state_sds, tok, tok).as_text()
+
+
+def _lower_native_train_step() -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_nd,
+        make_train_step,
+    )
+
+    model_cfg = _small_model_cfg()
+    mesh = make_mesh_nd(8, (2, 2, 2), ("dp", "sp", "tp"))
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg), jax.random.PRNGKey(0)
+    )
+    tok = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+    step = make_train_step(mesh, model_cfg, TrainConfig(grad_topo="psum"))
+    return step.lower(state_sds, tok, tok).as_text()
+
+
+def bucketed_sync_budget() -> tuple[int, int]:
+    """(expected fused-sync reduce_scatter/all_gather count, synced leaf
+    count) from the very bucket plan the sync executes — the generalized
+    form of the one-off guard in ``tests/test_hlo_lowering.py``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.bucketing import plan_buckets, replication_key
+    from ..parallel.train import init_train_state, state_specs
+
+    model_cfg = _small_model_cfg()
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg), jax.random.PRNGKey(0)
+    )
+    pspecs = state_specs(model_cfg, "tp")["params"]
+    flat_g, treedef = jax.tree.flatten(state_sds["params"])
+    flat_s = treedef.flatten_up_to(pspecs)
+    axis_sizes = {"dp": 2, "sp": 2, "tp": 2}
+    buckets = plan_buckets(
+        flat_g, flat_s, ("dp", "sp", "tp"),
+        axis_sizes=axis_sizes, bucket_bytes=1 << 30,
+    )
+    expected = sum(len(b.axes) for b in buckets)
+    n_synced = sum(1 for s in flat_s if replication_key(s, ("dp", "sp", "tp")))
+    return expected, n_synced
+
+
+def _lower_moe_step() -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.moe import MoEConfig
+    from ..parallel.moe_train import (
+        init_moe_train_state,
+        make_mesh_moe,
+        make_moe_train_step,
+    )
+    from ..parallel.train import TrainConfig
+
+    cfg = MoEConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        n_experts=4, top_k=1, moe_every=2,
+    )
+    mesh = make_mesh_moe(8, (1, 2, 2, 2))
+    state_sds = jax.eval_shape(
+        lambda k: init_moe_train_state(k, cfg), jax.random.PRNGKey(0)
+    )
+    tok = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+    step = make_moe_train_step(mesh, cfg, TrainConfig(bucket_bytes=1 << 30))
+    return step.lower(state_sds, tok, tok).as_text()
+
+
+def _lower_pipeline_step() -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.pipeline import (
+        init_pipeline_train_state,
+        make_mesh_4d,
+        make_pipeline_train_step,
+    )
+    from ..parallel.train import TrainConfig
+
+    cfg = _small_model_cfg()
+    mesh = make_mesh_4d(8, (1, 2, 2, 2))
+    state_sds = jax.eval_shape(
+        lambda k: init_pipeline_train_state(k, cfg), jax.random.PRNGKey(0)
+    )
+    tok = jax.ShapeDtypeStruct((4, 32), jnp.int32)
+    step = make_pipeline_train_step(
+        mesh, cfg, train_cfg=TrainConfig(bucket_bytes=1 << 30),
+        n_microbatches=2,
+    )
+    return step.lower(state_sds, tok, tok).as_text()
+
+
+def lower_entrypoints(full: bool = True) -> list[tuple[str, str, HloBudget]]:
+    """(name, stablehlo text, budget) for every linted entrypoint.
+
+    ``full=False`` lowers only the allreduce-family entrypoints (no model
+    steps) — the fast subset ``bench.py``'s tripwire uses.
+    """
+    _require_devices(8)
+    rows: list[tuple[str, str, HloBudget]] = [
+        (
+            "tree_allreduce_sum_4x2_f32",
+            _lower_allreduce((4, 2)),
+            HloBudget(
+                reduce_scatter=2, all_gather=2, all_reduce=0,
+                collective_permute=0,
+                collective_dtypes=("f32",),
+                note="one grouped rs+ag pair per stage",
+            ),
+        ),
+        (
+            "tree_allreduce_sum_4x2_bf16",
+            _lower_allreduce((4, 2), dtype="bfloat16"),
+            HloBudget(
+                reduce_scatter=2, all_gather=2, all_reduce=0,
+                collective_permute=0,
+                collective_dtypes=("bf16",),
+                note="bf16 path must not upcast collectives to f32",
+            ),
+        ),
+        (
+            "tree_allreduce_bor_4x2_i32",
+            _lower_allreduce((4, 2), op="bor"),
+            HloBudget(
+                reduce_scatter=0, all_gather=2, all_reduce=0,
+                collective_permute=2,
+                note="non-sum stages are the ppermute ring, one per stage",
+            ),
+        ),
+        (
+            "tree_allreduce_sum_4x2_chunks4",
+            _lower_allreduce((4, 2), chunks=4),
+            HloBudget(
+                reduce_scatter=8, all_gather=8, all_reduce=0,
+                collective_permute=0,
+                note="chunks=C multiplies scheduled collectives by exactly C",
+            ),
+        ),
+        (
+            "ring_allreduce_f32",
+            _lower_ring(),
+            HloBudget(
+                reduce_scatter=0, all_gather=0, all_reduce=0,
+                collective_permute=2,
+                note="two fori_loop neighbor permutes, O(1) in N",
+            ),
+        ),
+        (
+            "tree_allreduce_donated",
+            _lower_allreduce((4, 2), donate=True),
+            HloBudget(
+                reduce_scatter=2, all_gather=2,
+                require_donation=True,
+                note="donated input must lower with jax.buffer_donor",
+            ),
+        ),
+    ]
+    if not full:
+        return rows
+
+    native = collective_counts(_lower_native_train_step())
+    expected_sync, n_synced_leaves = bucketed_sync_budget()
+    bucketed_ir = _lower_train_step(bucket_bytes=1 << 30)
+    rows.append(
+        (
+            "train_step_bucketed",
+            bucketed_ir,
+            HloBudget(
+                reduce_scatter=native["reduce_scatter"] + expected_sync,
+                all_gather=native["all_gather"] + expected_sync,
+                # fused tails: at most one dense collective per bucket-axis
+                # on top of the step's own psums
+                all_reduce=native["all_reduce"] + expected_sync,
+                exact=False,
+                note=(
+                    f"sync collectives scale with buckets "
+                    f"({expected_sync} bucket-axes), never with the "
+                    f"{n_synced_leaves} gradient leaves"
+                ),
+            ),
+        )
+    )
+    rows.append(
+        (
+            "moe_train_step_bucketed",
+            _lower_moe_step(),
+            HloBudget(
+                # MoE earns its all_to_alls (dispatch+combine per MoE layer,
+                # forward and backward) but they must stay bounded and
+                # static: 1 MoE layer x 2 exchanges x (fwd + bwd) = 4
+                all_to_all=4,
+                exact=False,
+                note="MoE dispatch/combine only; no per-leaf sync blowup",
+            ),
+        )
+    )
+    rows.append(
+        (
+            "pipeline_train_step_bucketed",
+            _lower_pipeline_step(),
+            HloBudget(
+                all_to_all=0,
+                note="GPipe moves activations on collective_permute only",
+            ),
+        )
+    )
+    return rows
+
+
+def run_hlo_lint(full: bool = True) -> tuple[list[Violation], dict]:
+    """Lint every entrypoint; returns (violations, per-entrypoint detail)."""
+    violations: list[Violation] = []
+    detail: dict = {}
+    for name, ir, budget in lower_entrypoints(full=full):
+        vs = lint_ir(name, ir, budget)
+        violations += vs
+        detail[name] = {
+            "counts": collective_counts(ir),
+            "violations": len(vs),
+            "note": budget.note,
+        }
+    return violations, detail
+
+
+# ------------------------------------------------- mutation entrypoints
+
+
+def lower_leaf_unrolled_train_step() -> tuple[str, HloBudget]:
+    """The 'leaf-unrolled collectives' corruption: the per-leaf train step
+    (``bucket_bytes=0``) lowered against the *bucketed* budget.  The
+    mutation self-test asserts the linter rejects it — this is the
+    regression the bucketing tentpole exists to prevent."""
+    native = collective_counts(_lower_native_train_step())
+    expected_sync, n_synced = bucketed_sync_budget()
+    ir = _lower_train_step(bucket_bytes=0)
+    budget = HloBudget(
+        reduce_scatter=native["reduce_scatter"] + expected_sync,
+        all_gather=native["all_gather"] + expected_sync,
+        all_reduce=native["all_reduce"] + expected_sync,
+        exact=False,
+        note=f"bucketed budget applied to a per-leaf ({n_synced}-leaf) sync",
+    )
+    return ir, budget
+
+
+def lower_dtype_drifted_allreduce() -> tuple[str, HloBudget]:
+    """The 'dtype drift' corruption: a bf16 allreduce that silently
+    upcasts to f32 around the collective — numerically near-identical,
+    2x the wire bytes.  The linter must flag the f32 collectives."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import tree_allreduce
+    from ..parallel.mesh import flat_mesh
+
+    _require_devices(8)
+    mesh = flat_mesh(8, "ft")
+
+    def f(row):
+        drifted = tree_allreduce(
+            row[0].astype(jnp.float32), "ft", (4, 2)
+        )
+        return drifted.astype(jnp.bfloat16)[None]
+
+    fn = jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"))
+    ir = jax.jit(fn).lower(jnp.zeros((8, 64), jnp.bfloat16)).as_text()
+    budget = HloBudget(
+        reduce_scatter=2, all_gather=2,
+        collective_dtypes=("bf16",),
+        note="bf16 entrypoint: collectives must stay bf16",
+    )
+    return ir, budget

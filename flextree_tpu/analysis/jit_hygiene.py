@@ -1,0 +1,516 @@
+"""Layer 3: jit-hygiene lint — AST analysis of the library source for
+patterns that are legal Python but wrong inside a traced function.
+
+A jitted function executes its Python body ONCE, at trace time.  Three
+bug families follow, none of which any runtime test reliably catches:
+
+- **wall-clock / host RNG** (``time.*``, ``datetime.now``, ``random.*``,
+  ``np.random.*``): the value is baked into the compiled program as a
+  constant — timings measure tracing, "randomness" repeats forever.
+  (``jax.random`` is explicitly fine: it is functional and traced.)
+- **Python branching on traced values** (``if``/``while`` on something
+  derived from a traced argument): either a tracer-boolean error at trace
+  time in the lucky case, or — when the value happens to be concrete at
+  trace time — a silently specialized program.
+- **missing ``static_argnames``**: jitting a function whose config-like
+  parameters are passed dynamically retraces per call or fails on
+  unhashable types.
+
+Scope: the lint considers *traced* every function that lexically flows
+into a tracing entry point in its own module — decorated with / passed to
+``jax.jit`` / ``jax.shard_map`` / ``jax.vmap`` / ``jax.grad`` /
+``lax.scan`` / ``lax.fori_loop`` / ``lax.while_loop`` / ``lax.cond`` /
+``lax.switch`` / ``jax.checkpoint`` — plus everything lexically nested
+inside one.  Cross-module call graphs are deliberately out of scope (the
+direct jit surface is where the historical bugs live); anything the
+heuristics get wrong is waived in place with an auditable pragma::
+
+    x = time.perf_counter()  # jit-hygiene: ok — host-side timing helper
+
+The pragma must carry a reason and suppresses only its own line (or the
+whole function when placed on the ``def`` line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+from .base import Violation
+
+__all__ = ["scan_source", "scan_file", "run_jit_hygiene", "PRAGMA"]
+
+PRAGMA = "jit-hygiene: ok"
+
+#: Calls that trace their function argument(s).
+TRACING_FNS = frozenset(
+    {
+        "jax.jit",
+        "jit",
+        "jax.shard_map",
+        "shard_map",
+        "jax.vmap",
+        "jax.pmap",
+        "jax.grad",
+        "jax.value_and_grad",
+        "jax.checkpoint",
+        "jax.remat",
+        "jax.eval_shape",
+        "jax.linear_transpose",
+        "lax.scan",
+        "jax.lax.scan",
+        "lax.fori_loop",
+        "jax.lax.fori_loop",
+        "lax.while_loop",
+        "jax.lax.while_loop",
+        "lax.cond",
+        "jax.lax.cond",
+        "lax.switch",
+        "jax.lax.switch",
+        "lax.associative_scan",
+        "jax.lax.associative_scan",
+    }
+)
+
+#: Wall-clock sources: any of these called inside a traced function bakes
+#: trace-time state into the compiled program.
+WALL_CLOCK_PREFIXES = ("time.",)
+WALL_CLOCK_CALLS = frozenset(
+    {
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+        "datetime.date.today",
+        "datetime.now",
+        "perf_counter",
+        "monotonic",
+    }
+)
+
+#: Host RNG namespaces (jax.random is functional and fine).
+RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+RNG_OK_PREFIXES = ("jax.random.",)
+
+#: Attribute reads on a traced value that are static at trace time.
+STATIC_ATTRS = frozenset(
+    {"shape", "dtype", "size", "ndim", "sharding", "aval", "itemsize"}
+)
+
+#: Calls whose result is static (a Python value) even on traced operands.
+STATIC_CALLS = frozenset(
+    {
+        "lax.axis_size",
+        "jax.lax.axis_size",
+        "len",
+        "isinstance",
+        "issubclass",
+        "type",
+        "getattr",
+        "hasattr",
+        "callable",
+        "int",
+        "float",
+        "bool",
+        "str",
+        "tuple",
+        "list",
+        "dict",
+        "set",
+        "sorted",
+        "enumerate",
+        "zip",
+        "range",
+        "math.prod",
+        "Topology.resolve",
+        "get_op",
+    }
+)
+
+#: Parameter names that almost always want static_argnames when jitted.
+CONFIG_PARAM_NAMES = frozenset(
+    {"cfg", "config", "topo", "topology", "mesh", "axis_name", "spec", "op"}
+)
+
+
+def _qualname(node) -> str | None:
+    """Dotted name of a Name/Attribute chain (``jax.lax.scan``), else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class _Finding:
+    kind: str
+    lineno: int
+    func: str
+    detail: str
+
+
+class _FileScan:
+    def __init__(self, src: str, filename: str):
+        self.src_lines = src.splitlines()
+        self.filename = filename
+        self.tree = ast.parse(src, filename=filename)
+        self.findings: list[_Finding] = []
+        self.waived = 0
+
+    # ---------------------------------------------------- traced-fn set
+
+    def traced_functions(self) -> list[ast.AST]:
+        """FunctionDefs that flow into a tracing call, plus their lexically
+        nested defs."""
+        defs_by_name: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        roots: list[ast.AST] = []
+        seen: set[int] = set()
+
+        def add(fn):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                roots.append(fn)
+
+        # decorated defs
+        for fns in defs_by_name.values():
+            for fn in fns:
+                for dec in fn.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    q = _qualname(target)
+                    if q in TRACING_FNS or (
+                        q in {"partial", "functools.partial"}
+                        and isinstance(dec, ast.Call)
+                        and dec.args
+                        and _qualname(dec.args[0]) in TRACING_FNS
+                    ):
+                        add(fn)
+        # defs referenced in tracing calls
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = _qualname(node.func)
+            if q not in TRACING_FNS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                    for fn in defs_by_name[arg.id]:
+                        add(fn)
+                elif isinstance(arg, ast.Lambda):
+                    add(arg)
+        # lexically nested defs inside a traced def are traced too
+        out = list(roots)
+        for fn in roots:
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and sub is not fn
+                ):
+                    if id(sub) not in seen:
+                        seen.add(id(sub))
+                        out.append(sub)
+        return out
+
+    # ----------------------------------------------------------- checks
+
+    def _record(self, kind, node, func_name, detail, fn_waived=False):
+        lineno = getattr(node, "lineno", 0)
+        if self._waived(lineno) or fn_waived:
+            self.waived += 1
+            return
+        self.findings.append(_Finding(kind, lineno, func_name, detail))
+
+    def _waived(self, lineno: int) -> bool:
+        if 1 <= lineno <= len(self.src_lines):
+            return PRAGMA in self.src_lines[lineno - 1]
+        return False
+
+    def scan(self) -> list[_Finding]:
+        for fn in self.traced_functions():
+            self._scan_traced_fn(fn)
+        self._scan_jit_static_argnames()
+        return self.findings
+
+    @staticmethod
+    def _walk_own(fn):
+        """Walk ``fn``'s body without descending into nested function
+        defs — those are traced units of their own and scanned separately
+        (descending here would double-report their findings)."""
+        stack = list(
+            ast.iter_child_nodes(fn)
+            if not isinstance(fn, ast.Lambda)
+            else [fn.body]
+        )
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_traced_fn(self, fn):
+        name = getattr(fn, "name", "<lambda>")
+        # a pragma on the def line waives THIS node only (keyed by node,
+        # not by name — same-named defs and lambdas must not collide)
+        fn_waived = self._waived(getattr(fn, "lineno", 0))
+        # wall-clock / RNG calls anywhere in the traced body
+        for node in self._walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            q = _qualname(node.func)
+            if q is None:
+                continue
+            if q.startswith(RNG_OK_PREFIXES):
+                continue
+            if q.startswith(WALL_CLOCK_PREFIXES) or q in WALL_CLOCK_CALLS:
+                self._record(
+                    "wall-clock",
+                    node,
+                    name,
+                    f"`{q}()` inside traced `{name}` runs once at trace "
+                    f"time; the compiled program reuses that instant forever",
+                    fn_waived=fn_waived,
+                )
+            elif q.startswith(RNG_PREFIXES):
+                self._record(
+                    "rng",
+                    node,
+                    name,
+                    f"`{q}()` inside traced `{name}` bakes one host-RNG "
+                    f"draw into the program; use jax.random with a key",
+                    fn_waived=fn_waived,
+                )
+        # Python branches on traced values
+        self._scan_branches(fn, name, fn_waived)
+
+    def _static_argnames_of(self, fn) -> set[str]:
+        """Parameters declared static at the jit boundary — excluded from
+        the taint set (branching on them is exactly what static args are
+        for).  Reads ``static_argnames``/``static_argnums`` from
+        ``@partial(jax.jit, ...)``-style decorators and from
+        ``jax.jit(f, static_argnames=...)`` call sites naming ``f``."""
+        ordered = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        static: set[str] = set()
+
+        def harvest(call: ast.Call):
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) and isinstance(
+                            n.value, str
+                        ):
+                            static.add(n.value)
+                elif kw.arg == "static_argnums":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) and isinstance(
+                            n.value, int
+                        ):
+                            if 0 <= n.value < len(ordered):
+                                static.add(ordered[n.value])
+
+        for dec in getattr(fn, "decorator_list", []):
+            if not isinstance(dec, ast.Call):
+                continue
+            q = _qualname(dec.func)
+            if q in {"jax.jit", "jit"}:
+                harvest(dec)
+            elif (
+                q in {"partial", "functools.partial"}
+                and dec.args
+                and _qualname(dec.args[0]) in TRACING_FNS
+            ):
+                harvest(dec)
+        fn_name = getattr(fn, "name", None)
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _qualname(node.func) in {"jax.jit", "jit"}
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == fn_name
+            ):
+                harvest(node)
+        return static
+
+    def _scan_branches(self, fn, name, fn_waived=False):
+        params = set()
+        args = fn.args
+        for a in (
+            list(args.posonlyargs)
+            + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            params.add(a.arg)
+        tainted = params - self._static_argnames_of(fn)
+
+        def dyn(node) -> str | None:
+            """Name of an unprotected tainted use inside ``node``, or None."""
+            if isinstance(node, ast.Name):
+                return node.id if node.id in tainted else None
+            if isinstance(node, ast.Attribute):
+                if node.attr in STATIC_ATTRS:
+                    return None
+                return dyn(node.value)
+            if isinstance(node, ast.Call):
+                q = _qualname(node.func)
+                if q is not None and (
+                    q in STATIC_CALLS or q.rsplit(".", 1)[-1] in STATIC_CALLS
+                ):
+                    return None
+                for child in (
+                    [node.func] + node.args + [k.value for k in node.keywords]
+                ):
+                    hit = dyn(child)
+                    if hit:
+                        return hit
+                return None
+            if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                return None  # `x is (not) None`: a static sentinel test
+            for child in ast.iter_child_nodes(node):
+                hit = dyn(child)
+                if hit:
+                    return hit
+            return None
+
+        class V(ast.NodeVisitor):
+            def __init__(self, outer):
+                self.outer = outer
+
+            def visit_FunctionDef(self, node):
+                if node is not fn:
+                    return  # nested defs are scanned as their own unit
+                self.generic_visit(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_Lambda = visit_FunctionDef
+
+            def visit_Assign(self, node):
+                if dyn(node.value):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+                self.generic_visit(node)
+
+            def visit_AugAssign(self, node):
+                if dyn(node.value) and isinstance(node.target, ast.Name):
+                    tainted.add(node.target.id)
+                self.generic_visit(node)
+
+            def _check(self, node, label):
+                hit = dyn(node.test)
+                if hit:
+                    self.outer._record(
+                        "traced-branch",
+                        node,
+                        name,
+                        f"`{label}` in traced `{name}` tests `{hit}`, which "
+                        f"derives from a traced argument — use lax.cond/"
+                        f"jnp.where, or mark the argument static",
+                        fn_waived=fn_waived,
+                    )
+                self.generic_visit(node)
+
+            def visit_If(self, node):
+                self._check(node, "if")
+
+            def visit_While(self, node):
+                self._check(node, "while")
+
+            def visit_IfExp(self, node):
+                self._check(node, "conditional expression")
+
+        V(self).visit(fn)
+
+    def _scan_jit_static_argnames(self):
+        defs_by_name = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs_by_name.setdefault(node.name, node)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _qualname(node.func) not in {"jax.jit", "jit"}:
+                continue
+            if any(
+                k.arg in {"static_argnames", "static_argnums"}
+                for k in node.keywords
+            ):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            target = defs_by_name.get(node.args[0].id)
+            if target is None:
+                continue
+            suspects = [
+                a.arg
+                for a in target.args.args + target.args.kwonlyargs
+                if a.arg in CONFIG_PARAM_NAMES
+            ]
+            if suspects:
+                self._record(
+                    "static-argnames",
+                    node,
+                    target.name,
+                    f"jax.jit({target.name}) without static_argnames, but "
+                    f"`{target.name}` takes config-like parameter(s) "
+                    f"{suspects}: every distinct value retraces (or fails "
+                    f"to hash)",
+                    fn_waived=self._waived(target.lineno),
+                )
+
+
+def scan_source(src: str, filename: str = "<string>") -> tuple[list[Violation], int]:
+    """Lint one source blob; returns (violations, waived_count)."""
+    scan = _FileScan(src, filename)
+    findings = scan.scan()
+    out = [
+        Violation(
+            "jit",
+            f.kind,
+            f"{filename}:{f.lineno}",
+            f.detail,
+            src=f.lineno,
+        )
+        for f in findings
+    ]
+    return out, scan.waived
+
+
+def scan_file(path: str, rel: str | None = None) -> tuple[list[Violation], int]:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    return scan_source(src, rel or path)
+
+
+def run_jit_hygiene(root: str | None = None) -> tuple[list[Violation], dict]:
+    """Lint every ``.py`` file under the package root (default: the
+    installed ``flextree_tpu`` package itself)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = os.path.dirname(os.path.abspath(root))
+    violations: list[Violation] = []
+    files = waived = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            vs, w = scan_file(path, os.path.relpath(path, base))
+            violations += vs
+            waived += w
+            files += 1
+    return violations, {"files_scanned": files, "waived": waived}

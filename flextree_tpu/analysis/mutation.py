@@ -1,0 +1,213 @@
+"""Mutation self-test: the analyzer must distrust itself.
+
+A static checker that reports zero violations is indistinguishable from a
+static checker that checks nothing.  This harness seeds one corruption
+per known bug class into the artifact the corresponding layer consumes —
+the message *program* for layer 1, the lowered *StableHLO* for layer 2,
+*source text* for layer 3 — and asserts the layer reports the expected
+violation kind.  Any mutation that sails through means the analyzer lost
+a check, and the suite (CLI, CI gate, tier-1 test) fails.
+
+Classes (the acceptance matrix of ISSUE 3):
+
+====================  ======  ==========================================
+mutation              layer   expected violation kind
+====================  ======  ==========================================
+peer-swap             1       ``asymmetric-match`` (and ``deadlock``)
+dropped-block         1       ``dropped-block``
+double-count          1       ``double-count``
+chunk-overlap         1       ``chunk-overlap``
+crossed-order         1       ``deadlock`` (a real wait-for cycle)
+leaf-unrolled         2       ``budget``
+dtype-drift           2       ``dtype-drift``
+wall-clock            3       ``wall-clock``
+host-rng              3       ``rng``
+traced-branch         3       ``traced-branch``
+missing-static        3       ``static-argnames``
+====================  ======  ==========================================
+"""
+
+from __future__ import annotations
+
+from ..schedule.stages import Topology
+from .schedule_check import (
+    RECV,
+    SEND,
+    Half,
+    PostSet,
+    build_program,
+    check_program,
+)
+
+__all__ = ["MUTATIONS", "run_mutation_selftest"]
+
+
+# ----------------------------------------------------- layer 1 mutations
+
+
+def _mutate_peer_swap():
+    """Redirect one send half to the wrong peer — the receiver never hears
+    from the true sender."""
+    prog = build_program(Topology(8, (4, 2)), count=64)
+    ps = prog.posts[0][0]
+    for i, h in enumerate(ps.halves):
+        if h.kind == SEND:
+            wrong = (h.peer + 1) % 8 or 2
+            ps.halves[i] = Half(SEND, wrong, h.blocks)
+            break
+    return check_program(prog)
+
+
+def _mutate_dropped_block():
+    """Symmetrically drop one block from a matched send/recv pair — both
+    sides agree, so only conservation can catch it."""
+    prog = build_program(Topology(8, (4, 2)), count=64)
+    ps = prog.posts[0][0]
+    send = next(h for h in ps.halves if h.kind == SEND and len(h.blocks) > 1)
+    victim = send.blocks[0]
+
+    def drop(half):
+        return Half(half.kind, half.peer, tuple(b for b in half.blocks if b != victim))
+
+    ps.halves[ps.halves.index(send)] = drop(send)
+    peer_ps = prog.posts[send.peer][0]
+    for i, h in enumerate(peer_ps.halves):
+        if h.kind == RECV and h.peer == 0 and victim in h.blocks:
+            peer_ps.halves[i] = drop(h)
+    return check_program(prog)
+
+
+def _mutate_double_count():
+    """Send the same block to two peers — it gets reduced twice."""
+    prog = build_program(Topology(8, (4, 2)), count=64)
+    ps = prog.posts[0][0]
+    sends = [h for h in ps.halves if h.kind == SEND]
+    dup_block = sends[0].blocks[0]
+    i = ps.halves.index(sends[1])
+    ps.halves[i] = Half(SEND, sends[1].peer, sends[1].blocks + (dup_block,))
+    # keep the pair symmetric so only conservation fires
+    peer_ps = prog.posts[sends[1].peer][0]
+    for j, h in enumerate(peer_ps.halves):
+        if h.kind == RECV and h.peer == 0:
+            peer_ps.halves[j] = Half(RECV, 0, h.blocks + (dup_block,))
+    return check_program(prog)
+
+
+def _mutate_chunk_overlap():
+    """Shift a chunk's buffer span onto its neighbor — the interleaved
+    phase-2/phase-1 windows would alias."""
+    prog = build_program(Topology(8, (4, 2)), count=128, chunks=2)
+    off, size = prog.chunk_spans[1]
+    prog.chunk_spans[1] = (off - 8, size)
+    return check_program(prog)
+
+
+def _mutate_crossed_order():
+    """Serialize one stage's exchanges per rank in rotated (crossed) order
+    — a genuine wait-for cycle under blocking rendezvous."""
+    topo = Topology(3, (3,))
+    prog = build_program(topo, count=9)
+
+    def serialize(rank, peer_order):
+        ps = prog.posts[rank][0]
+        by_peer: dict[int, list[Half]] = {}
+        for h in ps.halves:
+            by_peer.setdefault(h.peer, []).append(h)
+        prog.posts[rank][0:1] = [
+            PostSet(rank, by_peer[p], ps.chunk, ps.phase, ps.stage)
+            for p in peer_order
+        ]
+
+    serialize(0, [2, 1])
+    serialize(1, [0, 2])
+    serialize(2, [1, 0])
+    return check_program(prog)
+
+
+# ----------------------------------------------------- layer 2 mutations
+
+
+def _mutate_leaf_unrolled():
+    from .hlo_lint import lint_ir, lower_leaf_unrolled_train_step
+
+    ir, budget = lower_leaf_unrolled_train_step()
+    return lint_ir("mutated:leaf_unrolled_train_step", ir, budget)
+
+
+def _mutate_dtype_drift():
+    from .hlo_lint import lint_ir, lower_dtype_drifted_allreduce
+
+    ir, budget = lower_dtype_drifted_allreduce()
+    return lint_ir("mutated:dtype_drifted_allreduce", ir, budget)
+
+
+# ----------------------------------------------------- layer 3 mutations
+
+_HYGIENE_MUTANT = '''
+import time, random
+import numpy as np
+import jax
+
+
+def make_step(cfg):
+    def step(x, topo):
+        t = time.perf_counter()
+        noise = np.random.standard_normal(4)
+        jitter = random.random()
+        if x > 0:
+            x = x + noise.sum() * jitter * t
+        return x
+    return jax.jit(step)
+'''
+
+
+def _mutate_hygiene(kind):
+    from .jit_hygiene import scan_source
+
+    def run():
+        vs, _ = scan_source(_HYGIENE_MUTANT, "mutated_source.py")
+        return vs
+
+    return run
+
+
+# ------------------------------------------------------------- harness
+
+#: name -> (expected_kind, expected_layer, thunk)
+MUTATIONS = {
+    "peer-swap": ("asymmetric-match", "schedule", _mutate_peer_swap),
+    "dropped-block": ("dropped-block", "schedule", _mutate_dropped_block),
+    "double-count": ("double-count", "schedule", _mutate_double_count),
+    "chunk-overlap": ("chunk-overlap", "schedule", _mutate_chunk_overlap),
+    "crossed-order": ("deadlock", "schedule", _mutate_crossed_order),
+    "leaf-unrolled": ("budget", "hlo", _mutate_leaf_unrolled),
+    "dtype-drift": ("dtype-drift", "hlo", _mutate_dtype_drift),
+    "wall-clock": ("wall-clock", "jit", _mutate_hygiene("wall-clock")),
+    "host-rng": ("rng", "jit", _mutate_hygiene("rng")),
+    "traced-branch": ("traced-branch", "jit", _mutate_hygiene("traced-branch")),
+    "missing-static": ("static-argnames", "jit", _mutate_hygiene("static-argnames")),
+}
+
+
+def run_mutation_selftest(include_hlo: bool = True) -> dict:
+    """Run every seeded corruption; returns a per-class report.
+
+    ``caught`` is True iff the expected (layer, kind) appears among the
+    violations the mutated artifact produced.  ``all_caught`` is the gate
+    the CLI and CI fail on.  ``include_hlo=False`` skips the two
+    lowering-based mutations (for JAX-less or device-less hosts).
+    """
+    report: dict = {"classes": {}, "all_caught": True}
+    for mut_name, (kind, layer, thunk) in MUTATIONS.items():
+        if not include_hlo and layer == "hlo":
+            continue
+        violations = thunk()
+        caught = any(v.layer == layer and v.kind == kind for v in violations)
+        report["classes"][mut_name] = {
+            "expected": f"{layer}/{kind}",
+            "caught": caught,
+            "violations_raised": len(violations),
+        }
+        if not caught:
+            report["all_caught"] = False
+    return report

@@ -1,0 +1,700 @@
+"""Layer 1: schedule model checker — prove a generated message program
+deadlock-free and conservation-correct before it touches a mesh.
+
+``schedule/validate.py`` proves invariants of the *plans* (partition,
+send/recv agreement, convergence).  This layer goes one level down: it
+builds the explicit per-rank **message program** a schedule executes —
+every send/recv half, in issue order, for tree / ring / lonely shapes and
+for the chunk-pipelined mode (``chunks=C``) — and model-checks the program
+itself.  The distinction matters for the mutation self-test: a corruption
+is seeded into the *program* (the thing a backend would actually run), so
+a checker that silently re-derives everything from the pristine plans
+would prove nothing.
+
+Checks (every violation names ``(stage, src, dst, block)``):
+
+1. **Peer symmetry** — every send half has exactly one matching recv half
+   in the same round and vice versa, with equal block sets (the
+   program-level twin of ``validate.stage_matches``).
+2. **Deadlock-freedom** — the program is executed under *blocking
+   rendezvous* semantics (each rank issues its post-sets strictly in
+   order; a post-set completes only when every half finds its counterpart
+   concurrently pending).  The checker runs that abstract machine to
+   quiescence: termination proves no cycle among blocking matches exists
+   under even the most pessimistic transport (no buffering); a stuck
+   frontier is reported as a deadlock cycle.  XLA's collectives are more
+   forgiving — this is deliberately the strongest transport model.
+3. **Chunk conservation** — per chunk, replayed from the program's own
+   halves: every reduce-scatter stage's sends partition the sender's
+   owned set (no block reduced twice, none dropped), final scatter
+   ownership tiles ``[0, N)``, and the allgather phase's closure leaves
+   every rank holding the full reduced vector.
+4. **Chunk-buffer overlap** — the chunk-pipelined mode slices one buffer;
+   the per-chunk element spans must be pairwise disjoint and tile the
+   divisible head exactly, so interleaved phases can never alias.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..schedule.plan import ring_plan
+from ..schedule.stages import LonelyTopology, Topology
+from ..schedule.validate import ScheduleError, stage_matches
+from .base import Violation
+
+__all__ = [
+    "Half",
+    "PostSet",
+    "Program",
+    "build_program",
+    "check_program",
+    "check_schedule",
+    "default_schedule_matrix",
+    "check_standard_schedules",
+]
+
+SEND, RECV = "send", "recv"
+
+
+@dataclass(frozen=True)
+class Half:
+    """One direction of one point-to-point transfer, as issued by ``rank``:
+    ``(kind=send, peer)`` means rank -> peer, ``(kind=recv, peer)`` means
+    peer -> rank.  ``blocks`` are chunk-local block indices in ``[0, N)``."""
+
+    kind: str
+    peer: int
+    blocks: tuple[int, ...]
+
+
+@dataclass
+class PostSet:
+    """Halves one rank posts *together* (nonblocking post + wait-all), the
+    unit of progress in the rendezvous machine.  A tree-stage pairwise
+    exchange is a 2-half post-set (send+recv, same peer); a ring step is a
+    2-half post-set (send right, recv left); a lonely fold/restore hop is a
+    single half."""
+
+    rank: int
+    halves: list[Half]
+    # coordinates for violation reports
+    chunk: int
+    phase: str  # "rs" | "ag" | "fold" | "restore"
+    stage: int
+
+
+@dataclass
+class Program:
+    """The full message program of one schedule execution."""
+
+    num_nodes: int
+    kind: str  # "tree" | "ring" | "lonely"
+    # per-rank ordered post-sets (issue order == trace order)
+    posts: dict[int, list[PostSet]] = field(default_factory=dict)
+    num_stages: int = 1
+    chunks: int = 1
+    # per-chunk element spans (offset, size) into the flat divisible head
+    chunk_spans: list[tuple[int, int]] = field(default_factory=list)
+    head_elems: int = 0
+
+    def postsets(self):
+        for rank in sorted(self.posts):
+            yield from self.posts[rank]
+
+
+# --------------------------------------------------------------------- build
+
+
+def _chunk_sizes(total: int, n: int, chunks: int) -> list[int]:
+    """Mirror of ``parallel.allreduce._chunk_sizes`` without importing JAX
+    (this package must stay importable on a JAX-less host for layers 1+3)."""
+    blocks = total // n
+    c = max(1, min(chunks, blocks))
+    base, rem = divmod(blocks, c)
+    return [(base + (1 if i < rem else 0)) * n for i in range(c)]
+
+
+def _tree_stage_postsets(topo: Topology, chunk: int, phase: str):
+    """Pairwise-exchange post-sets for every (rank, stage) of one phase.
+
+    Built from ``validate.stage_matches`` — the same matched-pair table the
+    validator proves agreement on — so plans and program cannot diverge.
+    Phase 2 replays the stages reversed with the roles swapped: the blocks
+    rank ``r`` *received* in stage ``i`` (its own residue chain) are what
+    it *sends* back, and vice versa (``mpi_mod.hpp:1050-1060``).
+    """
+    match_table: dict[tuple[int, int, int], tuple[int, ...]] = {}
+    for i, src, dst, blocks in stage_matches(topo):
+        match_table[(i, src, dst)] = blocks
+
+    out: dict[tuple[int, int], PostSet] = {}
+    stages = (
+        range(topo.num_stages)
+        if phase == "rs"
+        else reversed(range(topo.num_stages))
+    )
+    for i in stages:
+        for r in range(topo.num_nodes):
+            halves = []
+            for peer in topo.group_members(i, r):
+                if peer == r:
+                    continue
+                fwd = match_table[(i, r, peer)]  # r -> peer, phase 1
+                bwd = match_table[(i, peer, r)]  # peer -> r, phase 1
+                if phase == "rs":
+                    halves.append(Half(SEND, peer, fwd))
+                    halves.append(Half(RECV, peer, bwd))
+                else:
+                    # roles swap: r returns what it collected (bwd = r's
+                    # residue chain), receives the peer's chain back
+                    halves.append(Half(SEND, peer, bwd))
+                    halves.append(Half(RECV, peer, fwd))
+            out[(r, i)] = PostSet(r, halves, chunk, phase, i)
+    return out
+
+
+def _append_tree_chunk(prog: Program, topo: Topology, chunk: int, phase: str):
+    sets = _tree_stage_postsets(topo, chunk, phase)
+    stages = (
+        range(topo.num_stages)
+        if phase == "rs"
+        else reversed(range(topo.num_stages))
+    )
+    for i in stages:
+        for r in range(topo.num_nodes):
+            prog.posts.setdefault(r, []).append(sets[(r, i)])
+
+
+def build_program(topo, count: int | None = None, chunks: int = 1) -> Program:
+    """Build the message program for one schedule execution.
+
+    ``topo``: anything ``Topology.resolve`` accepts (already resolved
+    objects pass through).  ``count``: elements per rank (defaults to one
+    block per rank times N); only the divisible head is scheduled, exactly
+    as ``tree_allreduce`` slices it.  ``chunks``: the chunk-pipelined mode
+    — chunk ``c``'s allgather is issued between chunk ``c+1``'s
+    reduce-scatter and its own, the same interleaving the jitted program
+    traces.
+    """
+    if not isinstance(topo, (Topology, LonelyTopology)):
+        raise TypeError(f"resolve the topology first, got {type(topo)}")
+    n = topo.num_nodes
+    if count is None:
+        count = n * n
+    head = (count // n) * n
+
+    if isinstance(topo, LonelyTopology):
+        tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
+        prog = Program(n, "lonely", num_stages=tree.num_stages)
+        prog.head_elems = (count // m) * m
+        prog.chunk_spans = [(0, prog.head_elems)]
+        all_blocks = tuple(range(m))
+        for i in range(l):
+            prog.posts.setdefault(m + i, []).append(
+                PostSet(m + i, [Half(SEND, i, all_blocks)], 0, "fold", 0)
+            )
+            prog.posts.setdefault(i, []).append(
+                PostSet(i, [Half(RECV, m + i, all_blocks)], 0, "fold", 0)
+            )
+        _append_tree_chunk(prog, tree, 0, "rs")
+        _append_tree_chunk(prog, tree, 0, "ag")
+        for i in range(l):
+            prog.posts.setdefault(i, []).append(
+                PostSet(i, [Half(SEND, m + i, all_blocks)], 0, "restore", 0)
+            )
+            prog.posts.setdefault(m + i, []).append(
+                PostSet(m + i, [Half(RECV, i, all_blocks)], 0, "restore", 0)
+            )
+        return prog
+
+    if topo.is_ring:
+        prog = Program(n, "ring", num_stages=1)
+        prog.head_elems = head
+        prog.chunk_spans = [(0, head)]
+        plans = [ring_plan(n, r) for r in range(n)]
+        for step in range(2 * (n - 1)):
+            phase = "rs" if step < n - 1 else "ag"
+            for r in range(n):
+                snd, rcv = plans[r][step]
+                prog.posts.setdefault(r, []).append(
+                    PostSet(
+                        r,
+                        [
+                            Half(SEND, snd.peer, snd.blocks),
+                            Half(RECV, rcv.peer, rcv.blocks),
+                        ],
+                        0,
+                        phase,
+                        step,
+                    )
+                )
+        return prog
+
+    sizes = _chunk_sizes(head, n, chunks) if head else []
+    prog = Program(
+        n, "tree", num_stages=topo.num_stages, chunks=max(1, len(sizes))
+    )
+    prog.head_elems = head
+    off = 0
+    for s in sizes:
+        prog.chunk_spans.append((off, s))
+        off += s
+    # trace order of tree_allreduce: rs0, [rs_{c+1}, ag_c]..., ag_{C-1}
+    n_chunks = max(1, len(sizes))
+    _append_tree_chunk(prog, topo, 0, "rs")
+    for c in range(1, n_chunks):
+        _append_tree_chunk(prog, topo, c, "rs")
+        _append_tree_chunk(prog, topo, c - 1, "ag")
+    _append_tree_chunk(prog, topo, n_chunks - 1, "ag")
+    return prog
+
+
+# --------------------------------------------------------------------- check
+
+
+def _check_symmetry(prog: Program) -> list[Violation]:
+    """Every send half must pair with exactly one recv half at the peer, in
+    the same (chunk, phase, stage), with the identical block set."""
+    out: list[Violation] = []
+    index: dict[tuple, list[Half]] = {}
+    for ps in prog.postsets():
+        for h in ps.halves:
+            index.setdefault(
+                (ps.chunk, ps.phase, ps.stage, ps.rank, h.kind), []
+            ).append(h)
+
+    def name(ps):
+        return f"{prog.kind} chunk{ps.chunk}/{ps.phase}"
+
+    for ps in prog.postsets():
+        for h in ps.halves:
+            want = RECV if h.kind == SEND else SEND
+            mates = [
+                m
+                for m in index.get(
+                    (ps.chunk, ps.phase, ps.stage, h.peer, want), []
+                )
+                if m.peer == ps.rank
+            ]
+            src, dst = (
+                (ps.rank, h.peer) if h.kind == SEND else (h.peer, ps.rank)
+            )
+            if len(mates) != 1:
+                out.append(
+                    Violation(
+                        "schedule",
+                        "asymmetric-match",
+                        name(ps),
+                        f"{h.kind} half {src}->{dst} has {len(mates)} "
+                        f"counterpart halves (want exactly 1)",
+                        stage=ps.stage,
+                        src=src,
+                        dst=dst,
+                        block=h.blocks[0] if h.blocks else None,
+                    )
+                )
+            elif set(mates[0].blocks) != set(h.blocks):
+                diff = set(mates[0].blocks) ^ set(h.blocks)
+                out.append(
+                    Violation(
+                        "schedule",
+                        "asymmetric-match",
+                        name(ps),
+                        f"{src}->{dst} disagrees on blocks: one side "
+                        f"{sorted(h.blocks)}, other {sorted(mates[0].blocks)}",
+                        stage=ps.stage,
+                        src=src,
+                        dst=dst,
+                        block=min(diff) if diff else None,
+                    )
+                )
+    return out
+
+
+def _check_deadlock(prog: Program) -> list[Violation]:
+    """Run the blocking-rendezvous abstract machine to quiescence.
+
+    Each rank's post-sets issue strictly in order.  A pending half matches
+    when its counterpart half (same chunk/phase/stage coordinates, mirrored
+    direction, equal blocks) is pending at the peer; a post-set completes
+    when all its halves match; completion is simultaneous across ranks.
+    If the machine quiesces before every post-set completed, the frontier
+    is a genuine wait-for cycle (or an unmatched blocking op) — reported
+    per stuck rank with the exchange it is blocked on.
+    """
+    ptr = {r: 0 for r in prog.posts}
+    queues = {r: prog.posts[r] for r in prog.posts}
+
+    def frontier(r):
+        q = queues[r]
+        return q[ptr[r]] if ptr[r] < len(q) else None
+
+    def half_matches(ps: PostSet, h: Half) -> bool:
+        mate = frontier(h.peer)
+        if mate is None:
+            return False
+        if (mate.chunk, mate.phase, mate.stage) != (
+            ps.chunk,
+            ps.phase,
+            ps.stage,
+        ):
+            return False
+        want = RECV if h.kind == SEND else SEND
+        return any(
+            m.kind == want
+            and m.peer == ps.rank
+            and set(m.blocks) == set(h.blocks)
+            for m in mate.halves
+        )
+
+    while True:
+        completable = [
+            r
+            for r in queues
+            if (ps := frontier(r)) is not None
+            and all(half_matches(ps, h) for h in ps.halves)
+        ]
+        if not completable:
+            break
+        for r in completable:
+            ptr[r] += 1
+
+    out: list[Violation] = []
+    stuck = [r for r in queues if ptr[r] < len(queues[r])]
+    for r in sorted(stuck):
+        ps = frontier(r)
+        blocked = [h for h in ps.halves if not half_matches(ps, h)]
+        h = blocked[0] if blocked else ps.halves[0]
+        src, dst = (r, h.peer) if h.kind == SEND else (h.peer, r)
+        out.append(
+            Violation(
+                "schedule",
+                "deadlock",
+                f"{prog.kind} chunk{ps.chunk}/{ps.phase}",
+                f"rank {r} blocks forever on {h.kind} {src}->{dst} "
+                f"(cycle among {len(stuck)} stuck ranks: {sorted(stuck)})",
+                stage=ps.stage,
+                src=src,
+                dst=dst,
+                block=h.blocks[0] if h.blocks else None,
+            )
+        )
+    return out
+
+
+def _check_conservation(prog: Program) -> list[Violation]:
+    """Replay ownership per chunk from the program's own halves."""
+    out: list[Violation] = []
+    n = prog.num_nodes
+    if prog.kind == "ring":
+        return _check_ring_conservation(prog)
+    if prog.kind == "lonely":
+        n = n - sum(
+            1
+            for r, q in prog.posts.items()
+            if any(ps.phase == "fold" and ps.halves[0].kind == SEND for ps in q)
+        )
+
+    for c in range(prog.chunks):
+        # ---- reduce-scatter: sends partition owned; recvs define new owned
+        owned = {r: set(range(n)) for r in range(n)}
+        by_rs: dict[tuple[int, int], list[tuple[Half, PostSet]]] = {}
+        by_ag: dict[tuple[int, int], list[tuple[Half, PostSet]]] = {}
+        for ps in prog.postsets():
+            if ps.chunk != c or ps.rank >= n:
+                continue
+            for h in ps.halves:
+                if ps.phase == "rs":
+                    by_rs.setdefault((ps.rank, ps.stage), []).append((h, ps))
+                elif ps.phase == "ag":
+                    by_ag.setdefault((ps.rank, ps.stage), []).append((h, ps))
+        n_stages = prog.num_stages
+        where = f"{prog.kind} chunk{c}/rs"
+        for i in range(n_stages):
+            for r in range(n):
+                sent: dict[int, int] = {}
+                kept: set[int] = set()
+                for h, ps in by_rs.get((r, i), []):
+                    if h.kind == SEND:
+                        for b in h.blocks:
+                            if b in sent:
+                                out.append(
+                                    Violation(
+                                        "schedule",
+                                        "double-count",
+                                        where,
+                                        f"rank {r} sends block {b} to both "
+                                        f"{sent[b]} and {h.peer}: reduced twice",
+                                        stage=i, src=r, dst=h.peer, block=b,
+                                    )
+                                )
+                            sent[b] = h.peer
+                    else:
+                        kept |= set(h.blocks)
+                # a rank also keeps its own residue chain without sending it
+                # to itself (self-ops are skipped); its kept set IS the recv
+                # halves' union — sends must cover owned minus kept exactly
+                missing = owned[r] - set(sent) - kept
+                extra = set(sent) - owned[r]
+                for b in sorted(missing):
+                    out.append(
+                        Violation(
+                            "schedule",
+                            "dropped-block",
+                            where,
+                            f"rank {r} owns block {b} but neither sends nor "
+                            f"keeps it at stage {i}: its contribution is lost",
+                            stage=i, src=r, dst=None, block=b,
+                        )
+                    )
+                for b in sorted(extra):
+                    out.append(
+                        Violation(
+                            "schedule",
+                            "double-count",
+                            where,
+                            f"rank {r} sends block {b} it does not own at "
+                            f"stage {i} (already contributed upstream)",
+                            stage=i, src=r, dst=sent[b], block=b,
+                        )
+                    )
+                if not kept <= owned[r]:
+                    bad = min(kept - owned[r])
+                    out.append(
+                        Violation(
+                            "schedule",
+                            "double-count",
+                            where,
+                            f"rank {r} stage {i} receives partials for block "
+                            f"{bad} it no longer owns",
+                            stage=i, src=None, dst=r, block=bad,
+                        )
+                    )
+                owned[r] = kept
+        seen: set[int] = set()
+        for r in range(n):
+            dup = seen & owned[r]
+            if dup:
+                out.append(
+                    Violation(
+                        "schedule",
+                        "double-count",
+                        f"{prog.kind} chunk{c}",
+                        f"final scatter ownership overlaps on block "
+                        f"{min(dup)} (rank {r})",
+                        stage=n_stages - 1, src=None, dst=r, block=min(dup),
+                    )
+                )
+            seen |= owned[r]
+        for b in sorted(set(range(n)) - seen):
+            out.append(
+                Violation(
+                    "schedule",
+                    "dropped-block",
+                    f"{prog.kind} chunk{c}",
+                    f"no rank owns block {b} after reduce-scatter: it was "
+                    f"never fully reduced",
+                    stage=n_stages - 1, src=None, dst=None, block=b,
+                )
+            )
+
+        # ---- allgather closure: replay forwarding in issue order
+        holdings = {r: set(owned[r]) for r in range(n)}
+        for i in reversed(range(n_stages)):
+            new_holdings = {r: set(h) for r, h in holdings.items()}
+            for r in range(n):
+                for h, ps in by_ag.get((r, i), []):
+                    if h.kind != RECV:
+                        continue
+                    inbound = set(h.blocks)
+                    if not inbound <= holdings.get(h.peer, set()):
+                        bad = min(inbound - holdings.get(h.peer, set()))
+                        out.append(
+                            Violation(
+                                "schedule",
+                                "dropped-block",
+                                f"{prog.kind} chunk{c}/ag",
+                                f"rank {h.peer} forwards block {bad} it does "
+                                f"not hold at stage {i}",
+                                stage=i, src=h.peer, dst=r, block=bad,
+                            )
+                        )
+                    new_holdings[r] |= inbound
+            holdings = new_holdings
+        for r in range(n):
+            gaps = set(range(n)) - holdings[r]
+            if gaps:
+                out.append(
+                    Violation(
+                        "schedule",
+                        "dropped-block",
+                        f"{prog.kind} chunk{c}/ag",
+                        f"allgather closure fails: rank {r} ends without "
+                        f"blocks {sorted(gaps)}",
+                        stage=0, src=None, dst=r, block=min(gaps),
+                    )
+                )
+    return out
+
+
+def _check_ring_conservation(prog: Program) -> list[Violation]:
+    out: list[Violation] = []
+    n = prog.num_nodes
+    for r in range(n):
+        steps = [ps for ps in prog.posts.get(r, [])]
+        reduce_steps = [ps for ps in steps if ps.phase == "rs"]
+        gather_steps = [ps for ps in steps if ps.phase == "ag"]
+        folded = {r}
+        for ps in reduce_steps:
+            for h in ps.halves:
+                if h.kind == RECV:
+                    folded.update(h.blocks)
+        missing = set(range(n)) - folded
+        for b in sorted(missing):
+            out.append(
+                Violation(
+                    "schedule",
+                    "dropped-block",
+                    "ring/rs",
+                    f"rank {r} never folds a partial for block {b} in the "
+                    f"reduce phase",
+                    stage=len(reduce_steps), src=None, dst=r, block=b,
+                )
+            )
+        have = {(r + 1) % n}
+        for ps in gather_steps:
+            for h in ps.halves:
+                if h.kind == RECV:
+                    have.update(h.blocks)
+        for b in sorted(set(range(n)) - have):
+            out.append(
+                Violation(
+                    "schedule",
+                    "dropped-block",
+                    "ring/ag",
+                    f"rank {r} ends the allgather without block {b}",
+                    stage=len(gather_steps), src=None, dst=r, block=b,
+                )
+            )
+    return out
+
+
+def _check_chunk_spans(prog: Program) -> list[Violation]:
+    """Chunk buffer spans must be pairwise disjoint and tile the head."""
+    out: list[Violation] = []
+    spans = sorted(
+        range(len(prog.chunk_spans)), key=lambda i: prog.chunk_spans[i][0]
+    )
+    covered = 0
+    for idx in spans:
+        off, size = prog.chunk_spans[idx]
+        if off < covered:
+            out.append(
+                Violation(
+                    "schedule",
+                    "chunk-overlap",
+                    f"{prog.kind} chunk{idx}",
+                    f"chunk {idx} buffer [{off}, {off + size}) overlaps the "
+                    f"previous chunk's span ending at {covered}: interleaved "
+                    f"phases would alias",
+                    stage=None, src=None, dst=None, block=idx,
+                )
+            )
+        elif off > covered:
+            out.append(
+                Violation(
+                    "schedule",
+                    "chunk-overlap",
+                    f"{prog.kind} chunk{idx}",
+                    f"gap [{covered}, {off}) before chunk {idx}'s buffer: "
+                    f"those elements belong to no chunk and are never "
+                    f"reduced",
+                    stage=None, src=None, dst=None, block=idx,
+                )
+            )
+        covered = max(covered, off + size)
+    if prog.chunk_spans and covered != prog.head_elems:
+        out.append(
+            Violation(
+                "schedule",
+                "chunk-overlap",
+                f"{prog.kind}",
+                f"chunk spans cover [0, {covered}) but the divisible head is "
+                f"{prog.head_elems} elements",
+                stage=None, src=None, dst=None, block=None,
+            )
+        )
+    return out
+
+
+def check_program(prog: Program) -> list[Violation]:
+    """All program-level checks; order: symmetry, deadlock, conservation,
+    buffer spans (cheapest-to-localize first)."""
+    out = _check_symmetry(prog)
+    out += _check_deadlock(prog)
+    out += _check_conservation(prog)
+    out += _check_chunk_spans(prog)
+    return out
+
+
+def check_schedule(
+    topo_like, num_nodes: int | None = None, count: int | None = None,
+    chunks: int = 1,
+) -> list[Violation]:
+    """Resolve, build, and model-check one schedule.
+
+    A structurally-invalid topology (``Topology.resolve`` or plan
+    construction raising) is itself reported as a violation rather than an
+    analyzer crash — the CI gate must not confuse "schedule is wrong" with
+    "analyzer is broken".
+    """
+    try:
+        if isinstance(topo_like, (Topology, LonelyTopology)):
+            topo = topo_like
+        else:
+            if num_nodes is None:
+                raise ValueError("num_nodes required for unresolved specs")
+            topo = Topology.resolve(num_nodes, topo_like)
+        prog = build_program(topo, count=count, chunks=chunks)
+    except (ScheduleError, ValueError, TypeError) as e:
+        return [
+            Violation(
+                "schedule",
+                "invalid-topology",
+                str(topo_like),
+                f"{type(e).__name__}: {e}",
+            )
+        ]
+    return check_program(prog)
+
+
+def default_schedule_matrix(max_n: int = 16) -> list[tuple]:
+    """(spec, num_nodes, count, chunks) rows covering the shape families the
+    backends execute: flat / two-level / halving-doubling trees, the ring,
+    lonely shapes, non-divisible counts, and the chunk-pipelined mode."""
+    rows = [
+        ("8", 8, 64, 1),
+        ("4,2", 8, 64, 1),
+        ("2,2,2", 8, 64, 1),
+        ("2,4", 8, 96, 1),
+        ("1", 8, 64, 1),          # ring
+        ("3,2+1", 7, 84, 1),      # lonely
+        ("6+1", 7, 66, 1),
+        ("4,2", 8, 64, 4),        # chunk-pipelined
+        ("2,2,2", 8, 128, 3),
+        ("4,2", 8, 100, 2),       # non-divisible count, chunked
+        ("12", 12, 144, 1),
+        ("4,4", 16, 256, 2),
+    ]
+    return [r for r in rows if r[1] <= max_n]
+
+
+def check_standard_schedules(max_n: int = 16) -> tuple[list[Violation], int]:
+    """Model-check the default matrix; returns (violations, programs_checked)."""
+    violations: list[Violation] = []
+    checked = 0
+    for spec, n, count, chunks in default_schedule_matrix(max_n):
+        violations += check_schedule(spec, num_nodes=n, count=count, chunks=chunks)
+        checked += 1
+    return violations, checked

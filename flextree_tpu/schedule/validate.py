@@ -36,7 +36,14 @@ from dataclasses import dataclass
 from .plan import recv_plan, ring_plan, send_plan
 from .stages import Topology
 
-__all__ = ["ScheduleError", "ValidationStats", "validate", "validate_topology", "validate_ring"]
+__all__ = [
+    "ScheduleError",
+    "ValidationStats",
+    "validate",
+    "validate_topology",
+    "validate_ring",
+    "stage_matches",
+]
 
 
 class ScheduleError(AssertionError):
@@ -122,16 +129,11 @@ def validate_topology(topo: Topology) -> ValidationStats:
             messages += sum(1 for op in sends[r][i] if op.peer != r) * 2
 
     # send/recv agreement: sender's blocks for peer p == p's expected set
-    for r in range(n):
-        for i in range(topo.num_stages):
-            for op in sends[r][i]:
-                match = [o for o in recvs[op.peer][i] if o.peer == r]
-                if len(match) != 1 or set(match[0].blocks) != set(op.blocks):
-                    raise ScheduleError(
-                        f"stage {i}: rank {r} sends {sorted(op.blocks)} to "
-                        f"{op.peer}, but {op.peer} expects "
-                        f"{sorted(match[0].blocks) if match else None} from {r}"
-                    )
+    # (stage_matches raises on the first asymmetry; the walk itself is the
+    # check, and its match table is what analysis.schedule_check builds its
+    # per-rank message program from)
+    for _ in stage_matches(topo, sends=sends, recvs=recvs):
+        pass
 
     # convergence: the plan-derived final ownership tiles [0, N) exclusively
     seen: set[int] = set()
@@ -171,6 +173,39 @@ def validate_topology(topo: Topology) -> ValidationStats:
             )
 
     return ValidationStats(n, topo.widths, topo.num_stages, messages)
+
+
+def stage_matches(topo: Topology, sends=None, recvs=None):
+    """Yield every matched (stage, src, dst, blocks) phase-1 exchange.
+
+    The static analog of pairing each ``MPI_Isend`` with its ``MPI_Irecv``:
+    for every cross-rank send op, the receiver must hold *exactly one*
+    recv op naming the sender, with the identical block set — the
+    agreement invariant (docstring item 2) exposed as an iterable so
+    downstream analyses (``flextree_tpu.analysis.schedule_check``'s match
+    graph, traffic accounting) can walk the matched pairs instead of
+    re-deriving them.  Raises :class:`ScheduleError` on the first
+    unmatched or disagreeing pair.  ``sends``/``recvs`` accept
+    precomputed plan lists (the validator passes its own to avoid
+    rebuilding O(n) plans).
+    """
+    n = topo.num_nodes
+    if sends is None:
+        sends = [send_plan(topo, r) for r in range(n)]
+    if recvs is None:
+        recvs = [recv_plan(topo, r) for r in range(n)]
+    for r in range(n):
+        for i in range(topo.num_stages):
+            for op in sends[r][i]:
+                match = [o for o in recvs[op.peer][i] if o.peer == r]
+                if len(match) != 1 or set(match[0].blocks) != set(op.blocks):
+                    raise ScheduleError(
+                        f"stage {i}: rank {r} sends {sorted(op.blocks)} to "
+                        f"{op.peer}, but {op.peer} expects "
+                        f"{sorted(match[0].blocks) if match else None} from {r}"
+                    )
+                if op.peer != r:
+                    yield i, r, op.peer, tuple(op.blocks)
 
 
 def validate_ring(n: int) -> ValidationStats:

@@ -176,7 +176,11 @@ def restore_checkpoint(path: str | os.PathLike, mesh=None, specs=None, *, verify
     """
     path = os.fspath(path)
     try:
-        with np.load(path) as data:
+        # own the file handle: np.load(path) leaks its fd when a truncated/
+        # corrupt archive makes it raise after opening (ResourceWarning —
+        # an error under the suite's filterwarnings), so the outer `with`
+        # guarantees closure on every path
+        with open(path, "rb") as fh, np.load(fh) as data:
             structure = json.loads(bytes(data["__structure__"]).decode())
             leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
     except FileNotFoundError:

@@ -89,7 +89,9 @@ class TestHarness:
         rep = run_allreduce_bench(cfg)
         assert rep.correct
         assert rep.bus_bw_GBps > 0
-        assert rep.result_path and json.loads(open(rep.result_path).read())["correct"]
+        assert rep.result_path
+        with open(rep.result_path) as fh:
+            assert json.load(fh)["correct"]
 
     def test_xla_baseline_run(self):
         rep = run_allreduce_bench(BenchConfig(size=1000, repeat=2, comm_type="xla"))

@@ -1,0 +1,130 @@
+"""Direct unit coverage for ``schedule/analysis.py`` byte accounting.
+
+``test_schedule_properties.py`` pins these counters *against the cost
+model* (equality with the ``(w-1)/w * S/g`` pricing); these tests pin
+them against hand-computed byte counts, so a bug that shifted counter and
+model together — the exact failure mode a shared-formula refactor
+introduces — still gets caught.  No JAX involved: the counters walk pure
+plans.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from flextree_tpu.schedule.analysis import (
+    cross_slice_bytes,
+    stage_sent_bytes,
+    traffic_summary,
+)
+from flextree_tpu.schedule.stages import Topology
+
+
+class TestStageSentBytes:
+    def test_flat8_hand_computed(self):
+        # 64 elems / 8 ranks -> 8-elem blocks.  Flat stage: each rank sends
+        # one 8-elem block to each of 7 peers = 56 elems * 4 B = 224 B per
+        # phase (phase 2 returns the rank's own block to each peer: same).
+        rows = stage_sent_bytes(Topology.flat(8), 64, 4, rank=0)
+        assert rows == [(224, 224)]
+
+    def test_tree_4x2_hand_computed(self):
+        # stage 0 (w=4, gap=1): 3 peers x 16-elem residue chains = 192 B;
+        # stage 1 (w=2, gap=4): 1 peer x 8-elem chain = 32 B.
+        rows = stage_sent_bytes(Topology(8, (4, 2)), 64, 4, rank=0)
+        assert rows == [(192, 192), (32, 32)]
+
+    def test_every_rank_sends_the_same_totals(self):
+        topo = Topology(8, (2, 2, 2))
+        per_rank = [stage_sent_bytes(topo, 64, 4, r) for r in range(8)]
+        assert all(rows == per_rank[0] for rows in per_rank[1:])
+
+    def test_itemsize_scales_linearly(self):
+        topo = Topology(8, (4, 2))
+        b4 = stage_sent_bytes(topo, 64, 4, 0)
+        b8 = stage_sent_bytes(topo, 64, 8, 0)
+        assert [(2 * p1, 2 * p2) for p1, p2 in b4] == b8
+
+    def test_non_divisible_count_clamps_tail_blocks(self):
+        # count=10, N=8: split=2, blocks 0-4 full, block 5 has 0 elems
+        # after clamping?  span math: block b covers [2b, min(2b+2, 10)) —
+        # blocks 5,6,7 are empty/partial: block 5 = [10,10) empty... check
+        # totals instead of per-op: the counted bytes must equal walking
+        # the layout spans directly.
+        from flextree_tpu.schedule.blocks import BlockLayout
+        from flextree_tpu.schedule.plan import recv_plan, send_plan
+
+        topo = Topology(8, (4, 2))
+        count, itemsize, rank = 10, 4, 3
+        layout = BlockLayout(8, count)
+        rows = stage_sent_bytes(topo, count, itemsize, rank)
+        for i, (p1, p2) in enumerate(rows):
+            want1 = sum(
+                layout.span(b)[1] * itemsize
+                for op in send_plan(topo, rank)[i]
+                if op.peer != rank
+                for b in op.blocks
+            )
+            want2 = sum(
+                layout.span(b)[1] * itemsize
+                for op in recv_plan(topo, rank)[i]
+                if op.peer != rank
+                for b in op.blocks
+            )
+            assert (p1, p2) == (want1, want2)
+
+    def test_self_sends_cost_nothing(self):
+        # N=2 flat: one peer; the self op must not be counted.  Each rank
+        # sends its peer's 32-elem block once: 128 B per phase.
+        rows = stage_sent_bytes(Topology.flat(2), 64, 4, 0)
+        assert rows == [(128, 128)]
+
+
+class TestCrossSliceBytes:
+    def test_bad_slice_size_raises(self):
+        with pytest.raises(ValueError, match="must divide"):
+            cross_slice_bytes(Topology.flat(8), 64, 4, slice_size=3)
+        with pytest.raises(ValueError, match="must divide"):
+            cross_slice_bytes(Topology.flat(8), 64, 4, slice_size=0)
+
+    def test_single_slice_has_no_crossings(self):
+        out = cross_slice_bytes(Topology(8, (4, 2)), 64, 4, slice_size=8)
+        assert out["total"] == 0
+        assert out["per_chip_per_phase_worst"] == 0
+
+    def test_flat8_two_slices_hand_computed(self):
+        # slice_size=4: rank 0 (slice 0) exchanges with 4 off-slice peers,
+        # 8-elem blocks: 4*8*4 = 128 B per phase per rank; 8 ranks x 2
+        # phases -> 2048 B total.
+        out = cross_slice_bytes(Topology.flat(8), 64, 4, slice_size=4)
+        assert out["per_chip_per_phase_worst"] == 128
+        assert out["total"] == 2048
+        assert out["per_stage"] == [(1024, 1024)]
+
+    def test_ici_first_tree_crosses_only_final_stage(self):
+        out = cross_slice_bytes(Topology(8, (4, 2)), 64, 4, slice_size=4)
+        assert out["per_stage"][0] == (0, 0)
+        assert out["per_stage"][1][0] > 0
+
+
+class TestTrafficSummary:
+    def test_totals_aggregate_all_ranks(self):
+        topo = Topology(8, (4, 2))
+        summary = traffic_summary(topo, 64, 4)
+        per_rank = sum(
+            p1 + p2 for p1, p2 in stage_sent_bytes(topo, 64, 4, 0)
+        )
+        assert summary["total"] == 8 * per_rank  # symmetric shape
+        assert summary["per_rank_worst"] == per_rank
+        assert summary["num_nodes"] == 8
+        assert summary["widths"] == [4, 2]
+
+    def test_per_stage_matches_counters(self):
+        topo = Topology(8, (2, 2, 2))
+        summary = traffic_summary(topo, 64, 4)
+        assert len(summary["per_stage"]) == 3
+        for i, (p1, p2) in enumerate(summary["per_stage"]):
+            want = sum(
+                stage_sent_bytes(topo, 64, 4, r)[i][0] for r in range(8)
+            )
+            assert p1 == want and p2 == want

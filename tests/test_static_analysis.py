@@ -1,0 +1,344 @@
+"""Tier-1 coverage for the static-analysis suite (``flextree_tpu.analysis``).
+
+Two halves, mirroring the suite's self-distrust contract:
+
+- the CLEAN tree reports zero violations (schedule matrix, lowered
+  entrypoints, library source);
+- every seeded corruption class is caught by its layer — a checker that
+  passes everything is a failing test (``test_mutation_*``).
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from flextree_tpu.analysis import (
+    build_program,
+    check_program,
+    check_schedule,
+    check_standard_schedules,
+)
+from flextree_tpu.analysis.mutation import MUTATIONS, run_mutation_selftest
+from flextree_tpu.analysis.schedule_check import (
+    RECV,
+    SEND,
+    Half,
+    default_schedule_matrix,
+)
+from flextree_tpu.schedule.stages import LonelyTopology, Topology
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+# ------------------------------------------------------- layer 1: clean
+
+
+class TestScheduleCheckClean:
+    def test_standard_matrix_is_clean(self):
+        violations, programs = check_standard_schedules()
+        assert programs == len(default_schedule_matrix())
+        assert violations == []
+
+    @pytest.mark.parametrize(
+        "widths,n", [((8,), 8), ((4, 2), 8), ((2, 2, 2), 8), ((3, 4), 12)]
+    )
+    def test_tree_programs_clean(self, widths, n):
+        assert check_schedule(Topology(n, widths), count=n * 8) == []
+
+    @pytest.mark.parametrize("chunks", [1, 2, 3, 4])
+    def test_chunked_programs_clean(self, chunks):
+        assert check_schedule(Topology(8, (4, 2)), count=128, chunks=chunks) == []
+
+    def test_ring_program_clean(self):
+        assert check_schedule(Topology.ring(8), count=64) == []
+
+    def test_lonely_program_clean(self):
+        topo = LonelyTopology(7, Topology(6, (3, 2)), 1)
+        assert check_schedule(topo, count=84) == []
+
+    def test_invalid_topology_is_a_violation_not_a_crash(self):
+        vs = check_schedule("5,2", num_nodes=8, count=64)
+        assert [v.kind for v in vs] == ["invalid-topology"]
+
+    def test_program_shape(self):
+        prog = build_program(Topology(8, (4, 2)), count=128, chunks=2)
+        assert prog.chunks == 2
+        assert prog.chunk_spans == [(0, 64), (64, 64)]
+        # every rank issues rs+ag post-sets for both chunks: 2 stages x 2
+        # phases x 2 chunks
+        assert all(len(q) == 8 for q in prog.posts.values())
+
+
+# --------------------------------------------------- layer 1: mutations
+
+
+class TestScheduleCheckCatchesCorruption:
+    def _program(self, count=64, chunks=1):
+        return build_program(Topology(8, (4, 2)), count=count, chunks=chunks)
+
+    def test_swapped_peer_caught(self):
+        prog = self._program()
+        ps = prog.posts[0][0]
+        i, h = next(
+            (i, h) for i, h in enumerate(ps.halves) if h.kind == SEND
+        )
+        ps.halves[i] = Half(SEND, (h.peer + 1) % 8 or 2, h.blocks)
+        kinds = {v.kind for v in check_program(prog)}
+        assert "asymmetric-match" in kinds
+        assert "deadlock" in kinds  # unmatched blocking op also wedges
+
+    def test_violations_name_stage_src_dst_block(self):
+        prog = self._program()
+        ps = prog.posts[3][1]  # rank 3, stage 1
+        i, h = next(
+            (i, h) for i, h in enumerate(ps.halves) if h.kind == SEND
+        )
+        ps.halves[i] = Half(SEND, h.peer, ())
+        vs = check_program(prog)
+        assert vs, "empty send set must be flagged"
+        named = [
+            v for v in vs if v.stage is not None and v.src is not None
+        ]
+        assert named, f"violations must carry coordinates: {vs}"
+        assert any(v.stage == 1 for v in named)
+
+    def test_stage_skew_deadlocks(self):
+        # rank 0 skips its stage-0 exchanges entirely: its partners wait
+        # at stage 0 forever while it waits at stage 1
+        prog = self._program()
+        prog.posts[0] = prog.posts[0][1:]
+        kinds = {v.kind for v in check_program(prog)}
+        assert "deadlock" in kinds
+
+    def test_overlapping_chunk_spans_caught(self):
+        prog = self._program(count=128, chunks=2)
+        off, size = prog.chunk_spans[1]
+        prog.chunk_spans[1] = (off - 8, size)
+        kinds = {v.kind for v in check_program(prog)}
+        assert kinds == {"chunk-overlap"}
+
+    def test_gapped_chunk_spans_caught(self):
+        prog = self._program(count=128, chunks=2)
+        off, size = prog.chunk_spans[1]
+        prog.chunk_spans[1] = (off, size - 8)
+        assert "chunk-overlap" in {v.kind for v in check_program(prog)}
+
+    def test_mid_buffer_gap_caught_even_when_tail_aligns(self):
+        # gap between the chunks while the LAST span still ends exactly at
+        # head_elems — the end-coverage check alone would miss it
+        prog = self._program(count=128, chunks=2)
+        prog.chunk_spans[0] = (0, 56)
+        prog.chunk_spans[1] = (72, 56)
+        vs = [v for v in check_program(prog) if v.kind == "chunk-overlap"]
+        assert vs, "mid-buffer gap must be flagged"
+        assert any("gap" in v.detail for v in vs)
+
+
+# ------------------------------------------------------------- layer 2
+
+
+@needs_8_devices
+class TestHloLint:
+    def test_clean_entrypoints(self):
+        from flextree_tpu.analysis.hlo_lint import run_hlo_lint
+
+        violations, detail = run_hlo_lint(full=True)
+        assert violations == []
+        assert "train_step_bucketed" in detail
+
+    def test_fast_subset_is_clean_too(self):
+        from flextree_tpu.analysis.hlo_lint import run_hlo_lint
+
+        violations, detail = run_hlo_lint(full=False)
+        assert violations == []
+        assert "train_step_bucketed" not in detail
+
+    def test_budget_catches_extra_collectives(self):
+        from flextree_tpu.analysis.hlo_lint import HloBudget, lint_ir
+
+        ir = '"stablehlo.reduce_scatter"() : (tensor<16xf32>)\n' * 3
+        vs = lint_ir("synthetic", ir, HloBudget(reduce_scatter=2))
+        assert [v.kind for v in vs] == ["budget"]
+
+    def test_exact_budget_catches_vanished_collectives(self):
+        from flextree_tpu.analysis.hlo_lint import HloBudget, lint_ir
+
+        vs = lint_ir("synthetic", "", HloBudget(reduce_scatter=2, exact=True))
+        assert [v.kind for v in vs] == ["budget"]
+
+    def test_host_transfer_flagged(self):
+        from flextree_tpu.analysis.hlo_lint import HloBudget, lint_ir
+
+        ir = '%0 = "stablehlo.infeed"(%t) : (...)'
+        vs = lint_ir("synthetic", ir, HloBudget())
+        assert [v.kind for v in vs] == ["host-transfer"]
+
+    def test_dtype_budget_flags_upcast(self):
+        from flextree_tpu.analysis.hlo_lint import HloBudget, lint_ir
+
+        ir = '%1 = "stablehlo.all_gather"(%0) <{...}> : (tensor<2x8xf32>) -> tensor<16x8xf32>'
+        vs = lint_ir(
+            "synthetic", ir, HloBudget(collective_dtypes=("bf16",))
+        )
+        assert [v.kind for v in vs] == ["dtype-drift"]
+
+
+# ------------------------------------------------------------- layer 3
+
+
+class TestJitHygiene:
+    def test_library_source_is_clean(self):
+        from flextree_tpu.analysis.jit_hygiene import run_jit_hygiene
+
+        violations, detail = run_jit_hygiene()
+        assert violations == []
+        assert detail["files_scanned"] > 40
+
+    def test_pragma_waives_a_finding(self):
+        from flextree_tpu.analysis.jit_hygiene import scan_source
+
+        src = (
+            "import time, jax\n"
+            "def f(x):\n"
+            "    t = time.time()  # jit-hygiene: ok — test waiver\n"
+            "    return x * t\n"
+            "g = jax.jit(f)\n"
+        )
+        vs, waived = scan_source(src)
+        assert vs == []
+        assert waived == 1
+
+    def test_def_line_pragma_does_not_waive_same_named_sibling(self):
+        # two traced defs named `step`: a pragma on the first's def line
+        # must not silence findings in the second
+        from flextree_tpu.analysis.jit_hygiene import scan_source
+
+        src = (
+            "import time, jax\n"
+            "def make_a():\n"
+            "    def step(x):  # jit-hygiene: ok — host-side helper\n"
+            "        return x * time.time()\n"
+            "    return jax.jit(step)\n"
+            "def make_b():\n"
+            "    def step(x):\n"
+            "        return x * time.time()\n"
+            "    return jax.jit(step)\n"
+        )
+        vs, waived = scan_source(src)
+        assert waived == 1
+        assert [v.kind for v in vs] == ["wall-clock"]
+        assert vs[0].src == 8  # the unwaived sibling's line, not the first's
+
+    def test_static_argnames_suppress_branch_taint(self):
+        from flextree_tpu.analysis.jit_hygiene import scan_source
+
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('mode',))\n"
+            "def f(x, mode):\n"
+            "    if mode == 'fast':\n"
+            "        return x\n"
+            "    return x * 2\n"
+        )
+        vs, _ = scan_source(src)
+        assert vs == []
+
+    def test_branch_on_traced_param_flagged(self):
+        from flextree_tpu.analysis.jit_hygiene import scan_source
+
+        src = (
+            "import jax\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+            "g = jax.jit(f)\n"
+        )
+        vs, _ = scan_source(src)
+        assert [v.kind for v in vs] == ["traced-branch"]
+
+    def test_shape_branch_is_static_and_clean(self):
+        from flextree_tpu.analysis.jit_hygiene import scan_source
+
+        src = (
+            "import jax\n"
+            "def f(x):\n"
+            "    if x.shape[0] > 1 and x is not None and len(x.shape) > 2:\n"
+            "        return x\n"
+            "    return -x\n"
+            "g = jax.jit(f)\n"
+        )
+        vs, _ = scan_source(src)
+        assert vs == []
+
+    def test_nested_fn_inside_traced_fn_is_scanned(self):
+        from flextree_tpu.analysis.jit_hygiene import scan_source
+
+        src = (
+            "import time, jax\n"
+            "def outer(x):\n"
+            "    def inner(y):\n"
+            "        return y * time.perf_counter()\n"
+            "    return inner(x)\n"
+            "g = jax.jit(outer)\n"
+        )
+        vs, _ = scan_source(src)
+        assert [v.kind for v in vs] == ["wall-clock"]
+
+
+# ------------------------------------------------- mutation self-test
+
+
+class TestMutationSelfTest:
+    @pytest.mark.parametrize(
+        "mut_name",
+        [m for m, (_, layer, _t) in MUTATIONS.items() if layer != "hlo"],
+    )
+    def test_fast_mutation_caught(self, mut_name):
+        kind, layer, thunk = MUTATIONS[mut_name]
+        violations = thunk()
+        assert any(
+            v.layer == layer and v.kind == kind for v in violations
+        ), f"{mut_name}: expected {layer}/{kind}, got {violations}"
+
+    @needs_8_devices
+    @pytest.mark.parametrize(
+        "mut_name",
+        [m for m, (_, layer, _t) in MUTATIONS.items() if layer == "hlo"],
+    )
+    def test_hlo_mutation_caught(self, mut_name):
+        kind, layer, thunk = MUTATIONS[mut_name]
+        violations = thunk()
+        assert any(v.layer == layer and v.kind == kind for v in violations)
+
+    def test_selftest_report_all_caught(self):
+        report = run_mutation_selftest(include_hlo=False)
+        assert report["all_caught"]
+        assert all(c["caught"] for c in report["classes"].values())
+
+
+# ------------------------------------------------------------- the CLI
+
+
+@needs_8_devices
+def test_full_report_is_green_and_fast():
+    """The acceptance gate: a full in-process run of the CLI's report
+    builder — zero violations, every mutation class caught — inside the
+    60 s budget (it runs in single-digit seconds on this host)."""
+    import time
+
+    from flextree_tpu.analysis.__main__ import build_report
+
+    t0 = time.perf_counter()
+    report = build_report(include_hlo=True)
+    elapsed = time.perf_counter() - t0
+    assert report["ok"], report["violations"]
+    assert report["analysis_violations"] == 0
+    assert report["mutation_selftest"]["all_caught"]
+    assert len(report["mutation_selftest"]["classes"]) == len(MUTATIONS)
+    assert elapsed < 60, f"analysis took {elapsed:.1f}s, budget is 60s"
+    assert "4,2@8x64xf32" in report["traffic"]

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""CI gate: run the full static-analysis suite plus the repo lint.
+
+This is the one command a CI job (or a pre-merge human) runs:
+
+    python tools/run_static_checks.py [--report ANALYSIS.json]
+
+It executes, in order:
+
+1. **repo lint** — every ``.py`` file under ``flextree_tpu/``, ``tests/``
+   and ``tools/`` must byte-compile (catches syntax rot in files no test
+   imports), and no ``__pycache__``/``.pyc`` may be tracked by git;
+2. **the three analysis layers + mutation self-test** via
+   ``flextree_tpu.analysis`` (schedule model checker, HLO linter,
+   jit-hygiene lint), writing the JSON report.
+
+Exit status 0 iff everything is green — the same contract as
+``python -m flextree_tpu.analysis``, widened with the repo lint.  The
+suite also runs inside tier-1 (``tests/test_static_analysis.py``); this
+tool exists so the gate does not require pytest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LINT_DIRS = ("flextree_tpu", "tests", "tools")
+
+
+def repo_lint() -> list[str]:
+    """Byte-compile every source file; check no cache artifacts are
+    tracked.  Returns a list of problem strings."""
+    problems: list[str] = []
+    for d in LINT_DIRS:
+        for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, d)):
+            dirnames[:] = [x for x in dirnames if x != "__pycache__"]
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        compile(fh.read(), path, "exec")
+                except (SyntaxError, ValueError, UnicodeDecodeError) as e:
+                    problems.append(f"syntax: {os.path.relpath(path, REPO)}: {e}")
+    try:
+        tracked = subprocess.run(
+            ["git", "ls-files"], cwd=REPO, capture_output=True, text=True,
+            timeout=30,
+        ).stdout.splitlines()
+        for path in tracked:
+            if "__pycache__" in path or path.endswith(".pyc"):
+                problems.append(f"tracked cache artifact: {path}")
+    except (OSError, subprocess.SubprocessError):
+        pass  # not a git checkout (e.g. an sdist): skip the tracked check
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--report", default="ANALYSIS.json")
+    ap.add_argument(
+        "--skip-hlo", action="store_true",
+        help="pass through to the analysis CLI (no JAX backend needed)",
+    )
+    args = ap.parse_args(argv)
+
+    problems = repo_lint()
+    for p in problems:
+        print(f"repo-lint: {p}")
+    print(f"repo lint: {len(problems)} problems")
+
+    cli = [sys.executable, "-m", "flextree_tpu.analysis", "--report", args.report]
+    if args.skip_hlo:
+        cli.append("--skip-hlo")
+    rc = subprocess.run(cli, cwd=REPO).returncode
+    return 1 if problems else rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
