@@ -291,7 +291,7 @@ def bench_grad_bucketing() -> dict:
         GradSyncBenchConfig(n_leaves=48, leaf_size=4096, repeat=20)
     )
     step = run_train_step_bench(TrainStepBenchConfig(repeat=12))
-    return {
+    out = {
         "grad_sync_48leaf_ms": {
             k: round(v["min_ms"], 3) for k, v in sync["rows"].items()
         },
@@ -305,6 +305,14 @@ def bench_grad_bucketing() -> dict:
             step["rows"]["ours_fused"]["vs_per_leaf"], 3
         ),
     }
+    if "ours_fused_supervised" in step["rows"]:
+        # ISSUE-4 acceptance tripwire: watchdog + heartbeat on the
+        # fault-free path, as a ratio to the unsupervised fused step
+        # (1.02 = the 2% budget; WINS.md carries the measured numbers)
+        out["watchdog_heartbeat_overhead"] = round(
+            step["rows"]["ours_fused_supervised"]["supervision_overhead"], 4
+        )
+    return out
 
 
 def bench_tpu_kernel_guarded(timeout_s: int = 3300) -> dict | None:
@@ -388,6 +396,70 @@ def run_static_analysis_tripwire(timeout_s: int = 120) -> dict:
             pass
 
 
+_RUNTIME_TRIPWIRE_CODE = r'''
+import json, os, sys, tempfile
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from flextree_tpu.parallel.loop import FitConfig, fit
+
+class D:
+    def batch_at(self, step):
+        t = np.full((2, 4), float(step + 1)); return t, t
+
+poison = {{3}}
+def step_fn(state, tokens, targets):
+    s = int(np.asarray(state["step"])); g = float(tokens.mean())
+    if s in poison:
+        poison.discard(s); g = float("nan")
+    return ({{"step": np.int64(s + 1), "w": np.asarray(state["w"]) - g}},
+            {{"loss": g}})
+
+ck = tempfile.mkdtemp()
+fit({{"step": np.int64(0), "w": np.zeros(2)}}, step_fn, D(),
+    FitConfig(num_steps=6, ckpt_dir=ck, ckpt_every=100, log_every=0))
+with open(os.path.join(ck, "run_report.json")) as f:
+    print("REPORT_JSON: " + json.dumps(json.load(f)))
+'''
+
+
+def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
+    """Supplementary key ``runtime_recovery_violations`` — mirrors
+    ``analysis_violations``: a tiny supervised recovery exercise (one
+    injected NaN step through the real ``fit``) run in a subprocess, its
+    ``run_report.json`` checked against the expected accounting.  0 =
+    the recovery machinery works end-to-end on this exact tree; any
+    mismatch counts as a violation; a run that fails entirely reports
+    ``runtime_report_error`` with the key absent — absent reads as "not
+    verified", never as "clean".
+    """
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _RUNTIME_TRIPWIRE_CODE.format(repo=REPO)],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        report = None
+        for line in p.stdout.splitlines():
+            if line.startswith("REPORT_JSON: "):
+                report = json.loads(line[len("REPORT_JSON: "):])
+        if report is None:
+            return {
+                "runtime_report_error": f"no report line (rc={p.returncode}); "
+                f"stderr tail: {p.stderr[-200:]}"
+            }
+        violations = 0
+        violations += report.get("anomalies") != 1
+        violations += report.get("skipped_steps") != [3]
+        # the runtime-supervision keys must exist (machine-readable contract)
+        for key in ("step_timeouts", "stragglers", "membership_epochs",
+                    "preempted_at", "background_saves"):
+            violations += key not in report
+        return {"runtime_recovery_violations": violations}
+    except (subprocess.SubprocessError, OSError, ValueError) as e:
+        return {"runtime_report_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main() -> int:
     if "--tpu-child" in sys.argv:
         # child mode: the actual TPU bench, unguarded (parent holds the
@@ -416,6 +488,7 @@ def main() -> int:
         pass
     if result.get("metric") != "bench_error":
         result.update(run_static_analysis_tripwire())
+        result.update(run_runtime_report_tripwire())
     print(json.dumps(result))
     return 0
 

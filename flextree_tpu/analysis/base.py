@@ -23,8 +23,9 @@ class Violation:
     ``layer``: ``"schedule"`` | ``"hlo"`` | ``"jit"``.
     ``kind``: a stable machine-readable class (``"deadlock"``,
     ``"double-count"``, ``"dropped-block"``, ``"asymmetric-match"``,
-    ``"chunk-overlap"``, ``"budget"``, ``"dtype-drift"``, ``"host-transfer"``,
-    ``"donation"``, ``"wall-clock"``, ``"rng"``, ``"traced-branch"``,
+    ``"chunk-overlap"``, ``"unbounded-wait"``, ``"budget"``,
+    ``"dtype-drift"``, ``"host-transfer"``, ``"donation"``,
+    ``"wall-clock"``, ``"rng"``, ``"traced-branch"``,
     ``"static-argnames"``) — the mutation self-test asserts on these.
     ``where``: entrypoint / schedule / file the finding is in.
     ``stage``/``src``/``dst``/``block``: schedule coordinates (None for the

@@ -18,6 +18,7 @@ dropped-block         1       ``dropped-block``
 double-count          1       ``double-count``
 chunk-overlap         1       ``chunk-overlap``
 crossed-order         1       ``deadlock`` (a real wait-for cycle)
+watchdog-removal      1       ``unbounded-wait`` (lost recv deadline)
 leaf-unrolled         2       ``budget``
 dtype-drift           2       ``dtype-drift``
 wall-clock            3       ``wall-clock``
@@ -102,6 +103,17 @@ def _mutate_chunk_overlap():
     return check_program(prog)
 
 
+def _mutate_watchdog_removal():
+    """Strip the watchdog contract from an otherwise-clean program — the
+    static twin of deleting the step deadline from the runtime: a schedule
+    that can block forever on a dead peer must be rejected even though its
+    message pattern is perfectly correct (ISSUE 4's runtime-supervision
+    invariant: a timeout-wrapped rendezvous cannot deadlock-forever)."""
+    prog = build_program(Topology(8, (4, 2)), count=64)
+    prog.watchdogged = False
+    return check_program(prog)
+
+
 def _mutate_crossed_order():
     """Serialize one stage's exchanges per rank in rotated (crossed) order
     — a genuine wait-for cycle under blocking rendezvous."""
@@ -180,6 +192,7 @@ MUTATIONS = {
     "double-count": ("double-count", "schedule", _mutate_double_count),
     "chunk-overlap": ("chunk-overlap", "schedule", _mutate_chunk_overlap),
     "crossed-order": ("deadlock", "schedule", _mutate_crossed_order),
+    "watchdog-removal": ("unbounded-wait", "schedule", _mutate_watchdog_removal),
     "leaf-unrolled": ("budget", "hlo", _mutate_leaf_unrolled),
     "dtype-drift": ("dtype-drift", "hlo", _mutate_dtype_drift),
     "wall-clock": ("wall-clock", "jit", _mutate_hygiene("wall-clock")),

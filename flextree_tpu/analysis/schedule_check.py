@@ -32,6 +32,11 @@ Checks (every violation names ``(stage, src, dst, block)``):
 4. **Chunk-buffer overlap** — the chunk-pipelined mode slices one buffer;
    the per-chunk element spans must be pairwise disjoint and tile the
    divisible head exactly, so interleaved phases can never alias.
+5. **Watchdog contract** — every executed schedule carries the
+   runtime-deadline wrapper (``Program.watchdogged``): a timeout-wrapped
+   rendezvous cannot deadlock *forever* (the runtime converts the block
+   into a typed ``FT_STEP_TIMEOUT``), so a program that loses the wrapper
+   is an ``unbounded-wait`` violation regardless of its message pattern.
 """
 
 from __future__ import annotations
@@ -97,6 +102,13 @@ class Program:
     # per-chunk element spans (offset, size) into the flat divisible head
     chunk_spans: list[tuple[int, int]] = field(default_factory=list)
     head_elems: int = 0
+    # the watchdog contract: every executed schedule runs under a recv/step
+    # deadline (fit's StepWatchdog, the simulator's FaultPlan.recv_timeout),
+    # so a blocking rendezvous is BOUNDED — a deadlock is surfaced as a
+    # typed FT_STEP_TIMEOUT at runtime, never an infinite hang.  A program
+    # that loses this wrapper is itself a violation ("unbounded-wait"),
+    # independent of its message pattern being correct.
+    watchdogged: bool = True
 
     def postsets(self):
         for rank in sorted(self.posts):
@@ -362,6 +374,16 @@ def _check_deadlock(prog: Program) -> list[Violation]:
 
     out: list[Violation] = []
     stuck = [r for r in queues if ptr[r] < len(queues[r])]
+    # a watchdog-wrapped rendezvous cannot deadlock *forever*: the runtime
+    # converts the block into a typed FT_STEP_TIMEOUT — the deadlock is
+    # still a schedule bug (the step never completes), but the failure mode
+    # is a diagnostic, not a hang; say so in the report
+    bound = (
+        " (bounded at runtime: the watchdog converts this into "
+        "FT_STEP_TIMEOUT — still a schedule bug)"
+        if prog.watchdogged
+        else " (UNBOUNDED: no watchdog — this hangs forever)"
+    )
     for r in sorted(stuck):
         ps = frontier(r)
         blocked = [h for h in ps.halves if not half_matches(ps, h)]
@@ -372,8 +394,9 @@ def _check_deadlock(prog: Program) -> list[Violation]:
                 "schedule",
                 "deadlock",
                 f"{prog.kind} chunk{ps.chunk}/{ps.phase}",
-                f"rank {r} blocks forever on {h.kind} {src}->{dst} "
-                f"(cycle among {len(stuck)} stuck ranks: {sorted(stuck)})",
+                f"rank {r} blocks on {h.kind} {src}->{dst} "
+                f"(cycle among {len(stuck)} stuck ranks: {sorted(stuck)})"
+                + bound,
                 stage=ps.stage,
                 src=src,
                 dst=dst,
@@ -381,6 +404,25 @@ def _check_deadlock(prog: Program) -> list[Violation]:
             )
         )
     return out
+
+
+def _check_watchdog(prog: Program) -> list[Violation]:
+    """Every executed schedule must keep its watchdog wrapper: without a
+    recv/step deadline a blocking rendezvous whose peer died or stalled
+    hangs forever instead of surfacing ``FT_STEP_TIMEOUT``."""
+    if prog.watchdogged:
+        return []
+    return [
+        Violation(
+            "schedule",
+            "unbounded-wait",
+            prog.kind,
+            "program lost its watchdog wrapper (watchdogged=False): a "
+            "blocking rendezvous with no recv deadline can hang forever on "
+            "a dead or stalled peer instead of raising FT_STEP_TIMEOUT — "
+            "every executed schedule must run deadline-wrapped",
+        )
+    ]
 
 
 def _check_conservation(prog: Program) -> list[Violation]:
@@ -629,9 +671,10 @@ def _check_chunk_spans(prog: Program) -> list[Violation]:
 
 
 def check_program(prog: Program) -> list[Violation]:
-    """All program-level checks; order: symmetry, deadlock, conservation,
-    buffer spans (cheapest-to-localize first)."""
-    out = _check_symmetry(prog)
+    """All program-level checks; order: watchdog contract, symmetry,
+    deadlock, conservation, buffer spans (cheapest-to-localize first)."""
+    out = _check_watchdog(prog)
+    out += _check_symmetry(prog)
     out += _check_deadlock(prog)
     out += _check_conservation(prog)
     out += _check_chunk_spans(prog)

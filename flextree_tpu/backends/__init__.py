@@ -14,6 +14,7 @@ from .simulator import (
     FaultEvent,
     FaultPlan,
     ScheduleViolation,
+    StageTimeout,
     simulate_allreduce,
     simulate_ring_allreduce,
     simulate_tree_allreduce,
@@ -27,5 +28,6 @@ __all__ = [
     "FaultPlan",
     "FaultEvent",
     "FaultDetected",
+    "StageTimeout",
     "ScheduleViolation",
 ]

@@ -20,8 +20,9 @@ schedule bugs (sending a block the sender doesn't hold, receiving one nobody
 sent) instead of silently reading global state.
 
 Chaos mode: the mailbox is also a *fault-injection* point.  A
-:class:`FaultPlan` can drop, duplicate, reorder, corrupt, or delay any
-(phase, stage, src, dst, block) message, or kill a rank at a given stage,
+:class:`FaultPlan` can drop, duplicate, reorder, corrupt, delay, or hang
+any (phase, stage, src, dst, block) message, or kill a rank at a given
+stage,
 turning the simulator from a correctness oracle into a chaos oracle: every
 injected fault is either **recovered** (duplicates are deduplicated by
 message tag and record a ``recovered`` event; reorders are absorbed
@@ -53,6 +54,7 @@ __all__ = [
     "FaultPlan",
     "FaultEvent",
     "FaultDetected",
+    "StageTimeout",
     "ScheduleViolation",
     "FAULT_KINDS",
     "WHOLE_PAYLOAD",
@@ -82,7 +84,31 @@ class FaultDetected(ScheduleViolation):
         )
 
 
-FAULT_KINDS = ("drop", "duplicate", "reorder", "corrupt", "delay")
+class StageTimeout(FaultDetected):
+    """A per-recv deadline expired waiting on a *hung* sender — the
+    watchdog conversion of an infinite block into a typed error.
+
+    A ``hang`` fault models a stalled-but-alive peer (SIGSTOP, a wedged
+    host): unlike ``drop`` the message was never even posted, and unlike
+    ``kill`` the sender still holds its lease.  With
+    ``FaultPlan.recv_timeout`` configured the receive bounds its wait and
+    raises this (``code == "FT_STEP_TIMEOUT"``, the same taxonomy tag the
+    step-level watchdog in ``runtime.watchdog`` uses); without a deadline
+    the simulator refuses to model an infinite block silently and raises
+    :class:`ScheduleViolation` naming the missing watchdog.
+    """
+
+    code = "FT_STEP_TIMEOUT"
+
+    def __init__(self, phase, stage, src, dst, block, timeout_s):
+        self.timeout_s = timeout_s
+        super().__init__(
+            "hang", phase, stage, src, dst, block,
+            note=f"recv deadline {timeout_s:g}s exceeded ({self.code})",
+        )
+
+
+FAULT_KINDS = ("drop", "duplicate", "reorder", "corrupt", "delay", "hang")
 
 # block sentinel for single-message transfers carrying a rank's whole buffer
 # (the lonely-topology buddy fold/return hops)
@@ -146,6 +172,13 @@ class FaultPlan:
     first tree message; for the ring, ``stage`` is the step index).  Kills
     at or past the schedule's last step are never observable and therefore
     never detected.
+    ``recv_timeout``: the modeled per-recv deadline in seconds (the
+    message-granularity twin of the step watchdog's ``FT_STEP_TIMEOUT``).
+    With it set, a receive whose sender *hung* (a ``hang`` fault) raises
+    a typed :class:`StageTimeout` instead of blocking forever; without
+    it, the hang surfaces as a :class:`ScheduleViolation` naming the
+    missing deadline — the simulator never silently models an infinite
+    block.
     ``events``: populated during simulation — one entry per injection,
     plus one per dedup recovery or detection (reorder recovery is implicit
     in tag matching and records injection only), so harnesses can assert
@@ -154,6 +187,7 @@ class FaultPlan:
 
     faults: tuple[Fault, ...] = ()
     kill: Mapping[int, int] = field(default_factory=dict)
+    recv_timeout: float | None = None
     events: list[FaultEvent] = field(default_factory=list)
 
     def __post_init__(self):
@@ -215,6 +249,15 @@ class Mailbox:
         if not self.open(src, dst):
             return
         crc = zlib.crc32(np.ascontiguousarray(data).tobytes())
+        if self.plan.find("hang", *args):
+            # a stalled sender: the message is never posted at all (vs drop,
+            # where it was sent and lost) — the receive path converts this
+            # into StageTimeout when a recv deadline is configured
+            self.plan.record(
+                "hang", "injected", *args, note="sender stalled mid-stage"
+            )
+            self._lost[(src, dst, block)] = "sender hung mid-stage"
+            return
         if self.plan.find("drop", *args):
             self.plan.record("drop", "injected", *args, note="message lost")
             self._lost[(src, dst, block)] = "dropped in transit"
@@ -282,6 +325,26 @@ class Mailbox:
         if block not in box:
             cause = self._lost.get((src, dst, block))
             if cause is not None:
+                if "hung" in cause:
+                    if self.plan.recv_timeout is None:
+                        # refusing to model an infinite block silently: a
+                        # hung sender with no recv deadline IS the hang-
+                        # forever bug the watchdog exists to prevent
+                        raise ScheduleViolation(
+                            f"{_PHASE_NAMES[self.phase]} stage {self.stage}: "
+                            f"rank {dst} would block FOREVER on hung sender "
+                            f"{src} (block {block}) — no recv deadline "
+                            f"configured (FaultPlan.recv_timeout / "
+                            f"FT_STEP_TIMEOUT)"
+                        )
+                    self.plan.record(
+                        "hang", "detected", self.phase, self.stage, src, dst,
+                        block, note=cause,
+                    )
+                    raise StageTimeout(
+                        self.phase, self.stage, src, dst, block,
+                        self.plan.recv_timeout,
+                    )
                 kind = "delay" if "delay" in cause else "drop"
                 self.plan.record(
                     kind, "detected", self.phase, self.stage, src, dst, block,

@@ -390,6 +390,11 @@ class TrainStepBenchConfig:
     topo: str | None = None  # grad_topo for the sync
     repeat: int = 5
     chunks: int = 2
+    # add an ``ours_fused_supervised`` row: the fused step wrapped in the
+    # runtime supervision host path (step watchdog on its persistent
+    # worker thread + heartbeat Supervisor fed per-step durations) — the
+    # fault-free overhead the ISSUE-4 acceptance bounds at <= 2%
+    supervised: bool = True
 
 
 def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
@@ -463,12 +468,59 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
         states_out[name], _ = jax.block_until_ready(steps[name](state, toks, tgts))
         syncs[name] = make_sync(tc)
         jax.block_until_ready(syncs[name](grads))
-    step_times = _interleaved_times(
-        {n: (fn, (state, toks, tgts)) for n, fn in steps.items()}, cfg.repeat
-    )
-    sync_times = _interleaved_times(
-        {n: (fn, (grads,)) for n, fn in syncs.items()}, cfg.repeat
-    )
+
+    supervised_ctx = None
+    if cfg.supervised:
+        # the fault-free supervision host path around the fused step: the
+        # watchdog's queue round-trip to its persistent worker thread, a
+        # step_scope timing + EWMA update, and the Supervisor's two-store
+        # record_step (the beat itself rides the daemon thread, off-path)
+        import tempfile
+        import time as _time
+
+        from ..runtime.supervisor import Supervisor, SupervisorConfig
+        from ..runtime.watchdog import StepWatchdog
+        from ..utils.profiling import Ewma
+
+        hb_dir = tempfile.mkdtemp(prefix="ft_hb_bench_")
+        sup = Supervisor(
+            SupervisorConfig(rank=0, dir=hb_dir, interval_s=0.25)
+        ).start()
+        wd = StepWatchdog()
+        ewma = Ewma()
+        fused = steps["ours_fused"]
+
+        def supervised_step(s, tk, tg):
+            t0 = _time.perf_counter()
+            out = wd.run(fused, s, tk, tg, timeout_s=60.0, step=0)
+            dur = _time.perf_counter() - t0
+            ewma.update(dur)
+            sup.record_step(0, dur)
+            return out
+
+        steps["ours_fused_supervised"] = supervised_step
+        supervised_ctx = (sup, wd, hb_dir)  # before warmup: cleanup on raise
+
+    try:
+        if supervised_ctx is not None:
+            jax.block_until_ready(
+                steps["ours_fused_supervised"](state, toks, tgts)
+            )
+        step_times = _interleaved_times(
+            {n: (fn, (state, toks, tgts)) for n, fn in steps.items()},
+            cfg.repeat,
+        )
+        sync_times = _interleaved_times(
+            {n: (fn, (grads,)) for n, fn in syncs.items()}, cfg.repeat
+        )
+    finally:
+        if supervised_ctx is not None:  # don't leak threads/tmpdir on raise
+            import shutil
+
+            sup, wd, hb_dir = supervised_ctx
+            wd.close()
+            sup.stop()
+            shutil.rmtree(hb_dir, ignore_errors=True)
     rows = {}
     for name in train_cfgs:
         rows[name] = {
@@ -483,6 +535,19 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
         rows[name]["vs_per_leaf"] = (
             rows["per_leaf"]["train_step_ms"] / rows[name]["train_step_ms"]
         )
+    if cfg.supervised:
+        t = step_times["ours_fused_supervised"]
+        rows["ours_fused_supervised"] = {
+            "train_step_ms": t["min_ms"],
+            "train_step_avg_ms": t["avg_ms"],
+            "sync_ms": sync_times["ours_fused"]["min_ms"],  # same collective
+            "compute_ms": max(
+                t["min_ms"] - sync_times["ours_fused"]["min_ms"], 0.0
+            ),
+            # the acceptance number: supervised/unsupervised fused step
+            "supervision_overhead": t["min_ms"]
+            / rows["ours_fused"]["train_step_ms"],
+        }
 
     identical = True
     for name in ("ours_fused", "ours_chunked"):

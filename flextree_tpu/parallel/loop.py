@@ -14,11 +14,29 @@ this run), rewinds to the last verified checkpoint after
 ``max_bad_steps`` *consecutive* anomalies, and gives up with
 :class:`TrainingDiverged` once ``max_rewinds`` rewinds have not cured the
 divergence.  Restores go through ``restore_train_state``'s integrity
-fallback, so a truncated newest checkpoint silently falls back one.  The
-run's :class:`RunReport` (anomalies, skipped steps, rewinds, checkpoint
-fallbacks) is returned on the :class:`FitResult` and, when a checkpoint
-dir is configured, written there as ``RUN_REPORT.json`` — including when
-the run dies with :class:`TrainingDiverged`, which is exactly when the
+fallback, so a truncated newest checkpoint silently falls back one.
+
+Runtime supervision (the in-run half of the failure model): pass a
+:class:`Supervision` and the loop gains a step watchdog (a hung step
+raises a typed ``FT_STEP_TIMEOUT`` instead of blocking forever, with a
+bounded retry for transient stalls), heartbeat-driven membership (this
+rank beats through a ``runtime.Supervisor``; dead peers confirmed by the
+``membership`` view trigger **live shrink-to-survivors**: drain in-flight
+work, restore the latest CRC-verified checkpoint, replan the collective
+topology via ``planner.replan_for_survivors``, optionally rebuild the
+step through ``on_shrink``, and resume — no process restart), straggler
+accounting from per-rank step-duration EWMAs, and preemption-aware
+checkpointing (a :class:`~flextree_tpu.runtime.PreemptionGuard`'s SIGTERM
+flag takes a synchronous "checkpoint now" fast path within one step; a
+:class:`~flextree_tpu.runtime.BackgroundSaver` moves periodic saves off
+the step path so the rewind window stays small).
+
+The run's :class:`RunReport` (anomalies, skipped steps, rewinds,
+checkpoint fallbacks, step timeouts, stragglers, membership epoch
+transitions, preemption point) is returned on the :class:`FitResult`
+and, when a checkpoint dir is configured, written there as
+``run_report.json`` (via :meth:`RunReport.to_json`) — including when the
+run dies with :class:`TrainingDiverged`, which is exactly when the
 postmortem needs it.
 """
 
@@ -40,8 +58,17 @@ from ..utils.checkpoint import (
     save_train_state,
 )
 from ..utils.logging import get_logger
+from ..utils.profiling import step_scope
 
-__all__ = ["FitConfig", "FitResult", "RunReport", "TrainingDiverged", "fit"]
+__all__ = [
+    "FitConfig",
+    "FitResult",
+    "RunReport",
+    "ShrinkExhausted",
+    "Supervision",
+    "TrainingDiverged",
+    "fit",
+]
 
 log = get_logger("flextree.train")
 
@@ -50,6 +77,11 @@ class TrainingDiverged(RuntimeError):
     """The NaN/Inf guard exhausted its recovery budget: ``max_bad_steps``
     consecutive anomalies with no checkpoint to rewind to, or
     ``max_rewinds`` rewinds that did not cure the divergence."""
+
+
+class ShrinkExhausted(RuntimeError):
+    """Peers kept dying past the ``Supervision.max_shrinks`` budget — the
+    run refuses to keep replanning around a collapsing world."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +109,46 @@ class FitConfig:
 
 
 @dataclasses.dataclass
+class Supervision:
+    """Runtime-supervision wiring for :func:`fit` (every field optional —
+    a ``None`` field leaves that feature off, so ``Supervision()`` is the
+    no-op and the unsupervised loop is byte-for-byte the historical one).
+
+    ``supervisor``: a ``runtime.Supervisor`` — this rank's heartbeat
+    emitter; started/stopped by ``fit`` and fed each step's duration (the
+    straggler EWMA peers classify against).  ``membership``: the liveness
+    view — a ``runtime.MembershipView`` (or any callable returning
+    ``{rank: state_str}``) polled every ``check_every`` steps.
+    ``configured_world``: the membership roster size at start (defaults
+    to the first poll's).  ``step_timeout_s``: the per-step watchdog
+    deadline (``None`` reads ``FT_STEP_TIMEOUT``; unset = watchdog off);
+    a timed-out step is retried up to ``max_step_retries`` times when no
+    death is confirmed, then the :class:`~flextree_tpu.runtime.StepTimeout`
+    propagates.  ``on_shrink(n_alive, plan)``: rebuild hook for the
+    shrink path — return ``None`` to keep the current step, or a
+    ``(step_fn, mesh, state_specs)`` triple for the survivor world (the
+    plan carries the replanned widths).  ``nbytes_hint`` prices that
+    replan.  ``preemption``: a ``runtime.PreemptionGuard`` polled every
+    iteration for the checkpoint-now fast path.  ``background_saver``: a
+    ``runtime.BackgroundSaver`` — periodic saves go through it instead of
+    blocking the step path (the final save stays synchronous, after a
+    drain).
+    """
+
+    supervisor: Any = None
+    membership: Any = None
+    configured_world: int | None = None
+    check_every: int = 1
+    step_timeout_s: float | None = None
+    max_step_retries: int = 1
+    on_shrink: Callable | None = None
+    nbytes_hint: int = 4 << 20
+    max_shrinks: int = 2
+    preemption: Any = None
+    background_saver: Any = None
+
+
+@dataclasses.dataclass
 class RunReport:
     """End-of-run accounting of everything the recovery machinery did."""
 
@@ -86,9 +158,24 @@ class RunReport:
     ckpt_fallbacks: int = 0  # corrupt checkpoints skipped during restore
     resumed_from: int = 0
     init_retries: int = 0  # bring-up attempts beyond the first (launch layer)
+    # --- runtime supervision (all zero/empty when fit ran unsupervised) ---
+    step_timeouts: int = 0  # watchdog deadlines hit (FT_STEP_TIMEOUT)
+    step_retries: int = 0  # timed-out steps retried (no death confirmed)
+    stragglers: list = dataclasses.field(default_factory=list)
+    # membership epochs: entry 0 is the starting world, one more per live
+    # shrink — {"step", "alive", "configured", "topo", "dead"}
+    membership_epochs: list = dataclasses.field(default_factory=list)
+    preempted_at: int | None = None  # step the SIGTERM checkpoint ran at
+    background_saves: int = 0  # off-step-path checkpoint writes
 
     def to_payload(self) -> dict:
         return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        """The machine-readable form ``fit`` persists as run_report.json
+        (recovery events as stable keys, so tooling can gate on them the
+        way ``bench.py`` gates on ``analysis_violations``)."""
+        return json.dumps(self.to_payload(), indent=2, sort_keys=True)
 
 
 @dataclasses.dataclass
@@ -129,6 +216,7 @@ def fit(
     *,
     mesh=None,
     state_specs=None,
+    supervision: Supervision | None = None,
 ) -> FitResult:
     """Run ``step_fn(state, tokens, targets) -> (state, metrics)`` for
     ``cfg.num_steps`` total steps over ``dataset`` (an ``LMDataset``).
@@ -136,15 +224,23 @@ def fit(
     ``state['step']`` is the single source of truth for progress: batches
     are addressed by it, checkpoints are named by it, and resume reads it
     back.  Pass ``mesh``/``state_specs`` to restore sharded.
+
+    ``supervision`` (optional) arms the runtime-supervision layer — step
+    watchdog, heartbeat membership with live shrink-to-survivors,
+    straggler accounting, preemption checkpointing; see
+    :class:`Supervision`.  Without it the loop is the historical one.
     """
     report = RunReport()
+    sup = supervision
+    # mutable current-epoch execution context: live shrink swaps these
+    cur_step_fn, cur_mesh, cur_specs = step_fn, mesh, state_specs
 
     def _fallback(bad_path, exc):
         report.ckpt_fallbacks += 1
 
     def _restore():
         return restore_train_state(
-            cfg.ckpt_dir, mesh=mesh, specs=state_specs, on_fallback=_fallback
+            cfg.ckpt_dir, mesh=cur_mesh, specs=cur_specs, on_fallback=_fallback
         )
 
     resumed_from = 0
@@ -168,12 +264,211 @@ def fit(
         return None
 
     batches = _batches(start)
+
+    # ---- runtime supervision wiring (sup=None leaves the historical loop)
+    watchdog = None
+    step_timeout = None
+    world: int | None = None  # current epoch's alive count
+    known_dead: set = set()
+    flagged_stragglers: set = set()
+    shrinks = 0
+    timeout_retries = 0
+    if sup is not None:
+        from ..runtime.watchdog import StepTimeout, StepWatchdog, step_timeout_from_env
+
+        step_timeout = (
+            sup.step_timeout_s
+            if sup.step_timeout_s is not None
+            else step_timeout_from_env()
+        )
+        if step_timeout is not None:
+            watchdog = StepWatchdog()
+        if sup.supervisor is not None:
+            sup.supervisor.start()
+
+        def _poll_membership() -> dict | None:
+            """Normalize the liveness source to ``{rank: state_str}``."""
+            m = sup.membership
+            if m is None:
+                return None
+            if hasattr(m, "poll"):
+                return {r: s.state for r, s in m.poll().items()}
+            return dict(m())
+
+        def _drained_saves(timeout=30.0) -> bool:
+            """True when no background save is pending/in flight.  A False
+            return means a slow save still owns the directory — the caller
+            must NOT start a second writer (or a restore) against it."""
+            if sup.background_saver is None:
+                return True
+            ok = sup.background_saver.drain(timeout)
+            if not ok:
+                log.warning(
+                    "background save still in flight after %.0fs drain; "
+                    "skipping the conflicting synchronous writer", timeout,
+                )
+            return ok
+
+        def _feed_supervisor(dur_s):
+            if sup.supervisor is not None:
+                sup.supervisor.record_step(step, dur_s)
+
+        def _materialized_step(st, tk, tg):
+            # JAX dispatch is async: a jitted step returns unmaterialized
+            # futures in milliseconds even when a dead peer has wedged the
+            # collective — the block would then happen OUTSIDE the deadline
+            # at the metrics fetch.  Materialize inside the watchdogged
+            # call so FT_STEP_TIMEOUT covers device execution, not just
+            # dispatch.  (The nan_guard device_gets the metrics every step
+            # anyway, so this adds no extra host-device sync per step.)
+            return jax.block_until_ready(cur_step_fn(st, tk, tg))
+
+        def _shrink(at_step, new_dead):
+            """Live shrink-to-survivors: drain, rebuild, restore, resume."""
+            nonlocal state, world, shrinks, step, batches
+            nonlocal cur_step_fn, cur_mesh, cur_specs
+            from ..planner.choose import replan_for_survivors
+
+            prev_world = world
+            n_alive = max(1, world - len(new_dead))
+            plan = replan_for_survivors(
+                n_alive, sup.nbytes_hint, configured=prev_world
+            )
+            log.warning(
+                "membership shrink at step %d: ranks %s dead, %d/%d alive; "
+                "replanned topo %s",
+                at_step, new_dead, n_alive, prev_world, plan.to_ft_topo(),
+            )
+            # drain in-flight work: pending background saves first (the old
+            # epoch's prefetcher is dropped below when batches reseek)
+            _drained_saves(timeout=None)  # restore must never race a save
+            rebuilt = (
+                sup.on_shrink(n_alive, plan) if sup.on_shrink is not None else None
+            )
+            if rebuilt is not None:
+                cur_step_fn, cur_mesh, cur_specs = rebuilt
+            if cfg.ckpt_dir and latest_checkpoint(cfg.ckpt_dir):
+                state = _restore()
+                step = int(np.asarray(jax.device_get(state["step"])))
+                log.warning(
+                    "restored checkpointed step %d for the survivor world", step
+                )
+            world = n_alive
+            shrinks += 1
+            report.membership_epochs.append(
+                {
+                    "step": at_step,
+                    "alive": n_alive,
+                    "configured": prev_world,
+                    "topo": plan.to_ft_topo(),
+                    "dead": list(new_dead),
+                }
+            )
+            batches = _batches(step)
+
+        def _membership_tick(at_step) -> str:
+            """One liveness poll: record stragglers, shrink on new deaths.
+            Returns "shrunk" | "ok" | "unknown" (no membership source)."""
+            nonlocal world
+            statuses = _poll_membership()
+            if statuses is None:
+                return "unknown"
+            if world is None:
+                world = sup.configured_world or len(statuses)
+            for r, st in sorted(statuses.items()):
+                if st == "straggler" and r not in flagged_stragglers:
+                    flagged_stragglers.add(r)
+                    report.stragglers.append({"rank": r, "step": at_step})
+                    log.warning(
+                        "rank %d classified straggler at step %d", r, at_step
+                    )
+            new_dead = sorted(
+                r
+                for r, st in statuses.items()
+                if st == "dead" and r not in known_dead
+            )
+            if not new_dead:
+                return "ok"
+            known_dead.update(new_dead)
+            if shrinks >= sup.max_shrinks:
+                raise ShrinkExhausted(
+                    f"ranks {new_dead} died at step {at_step} after "
+                    f"{shrinks} shrink(s); max_shrinks={sup.max_shrinks}"
+                )
+            _shrink(at_step, new_dead)
+            return "shrunk"
+
+        # epoch 0: the starting world
+        if sup.membership is not None or sup.configured_world:
+            statuses0 = _poll_membership() or {}
+            world = sup.configured_world or (len(statuses0) or None)
+            if world:
+                report.membership_epochs.append(
+                    {
+                        "step": start,
+                        "alive": world,
+                        "configured": world,
+                        "topo": None,
+                        "dead": [],
+                    }
+                )
+
     try:
         while step < cfg.num_steps:
+            if sup is not None:
+                if sup.preemption is not None and sup.preemption.preempted:
+                    # the checkpoint-now fast path: at most one step lost
+                    if cfg.ckpt_dir and _drained_saves():
+                        # drain timed out -> the in-flight background save
+                        # IS a recent checkpoint; racing its rotation with
+                        # a second writer would be worse than one lost step
+                        save_train_state(
+                            cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep
+                        )
+                    report.preempted_at = step
+                    log.warning(
+                        "preemption: checkpointed at step %d, exiting", step
+                    )
+                    break
+                if (
+                    sup.membership is not None
+                    and step % max(1, sup.check_every) == 0
+                    and _membership_tick(step) == "shrunk"
+                ):
+                    continue
             tokens, targets = (
                 next(batches) if batches is not None else dataset.batch_at(step)
             )
-            new_state, metrics = step_fn(state, tokens, targets)
+            if sup is None:
+                new_state, metrics = cur_step_fn(state, tokens, targets)
+            else:
+                try:
+                    with step_scope(on_duration=_feed_supervisor):
+                        new_state, metrics = (
+                            watchdog.run(
+                                _materialized_step, state, tokens, targets,
+                                timeout_s=step_timeout, step=step,
+                            )
+                            if watchdog is not None
+                            else cur_step_fn(state, tokens, targets)
+                        )
+                except StepTimeout as e:
+                    report.step_timeouts += 1
+                    log.warning("%s", e)
+                    batches = _batches(step)  # reseek: the batch was consumed
+                    if _membership_tick(step) == "shrunk":
+                        timeout_retries = 0
+                        continue
+                    if timeout_retries < sup.max_step_retries:
+                        timeout_retries += 1
+                        report.step_retries += 1
+                        log.warning(
+                            "retrying step %d after timeout (%d/%d)",
+                            step, timeout_retries, sup.max_step_retries,
+                        )
+                        continue
+                    raise
+                timeout_retries = 0
             if cfg.nan_guard and not _metrics_finite(metrics):
                 report.anomalies += 1
                 report.skipped_steps.append(step)
@@ -193,6 +488,10 @@ def fit(
                             f"still diverging after {report.rewinds} rewinds "
                             f"(step {step})"
                         )
+                    if sup is not None:
+                        # never race an in-flight background save's rotation
+                        # with the restore (the saver forbids two writers)
+                        _drained_saves(timeout=None)
                     state = _restore()
                     report.rewinds += 1
                     bad_streak = 0
@@ -213,14 +512,34 @@ def fit(
                 rate = (step - start) / (time.perf_counter() - t0)
                 log.info("step %d loss %.4f (%.1f steps/s)", step, loss, rate)
             if cfg.ckpt_dir and cfg.ckpt_every and step % cfg.ckpt_every == 0:
-                save_train_state(cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep)
-        if cfg.ckpt_dir and step > start:
-            save_train_state(cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep)
+                if sup is not None and sup.background_saver is not None:
+                    # off-step-path save: the step loop never blocks on
+                    # serialization + fsync, so ckpt_every can be small
+                    sup.background_saver.submit(state)
+                else:
+                    save_train_state(
+                        cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep
+                    )
+        # the preemption fast path already saved this exact state — a second
+        # serialize+fsync would double the cost inside the grace window
+        if cfg.ckpt_dir and step > start and report.preempted_at is None:
+            if sup is None or _drained_saves():
+                save_train_state(
+                    cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep
+                )
     finally:
+        if sup is not None:
+            if sup.background_saver is not None:
+                sup.background_saver.drain()
+                report.background_saves = sup.background_saver.saves
+            if sup.supervisor is not None:
+                sup.supervisor.stop()
+            if watchdog is not None:
+                watchdog.close()
         # the accounting matters MOST for runs that die (a TrainingDiverged
         # postmortem needs the anomaly/rewind trail) — write it regardless
         if cfg.ckpt_dir:
             os.makedirs(cfg.ckpt_dir, exist_ok=True)
-            with open(os.path.join(cfg.ckpt_dir, "RUN_REPORT.json"), "w") as f:
-                json.dump(report.to_payload(), f, indent=2, sort_keys=True)
+            with open(os.path.join(cfg.ckpt_dir, "run_report.json"), "w") as f:
+                f.write(report.to_json())
     return FitResult(state, losses, step - start, resumed_from, report)

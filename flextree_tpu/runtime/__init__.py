@@ -1,0 +1,63 @@
+"""Runtime supervision: keep a training run alive through mid-run failures.
+
+PR 1 made *bring-up* fault-tolerant (``parallel.launch``: retry/backoff,
+degrade-to-survivors); this package covers the run itself.  Once ``fit``
+is stepping, a slow, hung, or preempted worker otherwise stalls every
+collective forever — there is no in-run failure detection in XLA's
+collectives on this pin, so the detection has to live at the host level:
+
+- :mod:`.supervisor` — heartbeat/lease membership.  Every process runs a
+  :class:`Supervisor` daemon thread writing lease-stamped beats (rank,
+  step, step-duration EWMA) into a shared directory; a
+  :class:`MembershipView` classifies peers as healthy / straggler / dead
+  from lease age and per-step progress, the way the launcher's liveness
+  probe classified processes at bring-up.
+- :mod:`.watchdog` — the step deadline.  :class:`StepWatchdog` runs the
+  step on a persistent worker thread and converts a hang into a typed
+  :class:`StepTimeout` (``FT_STEP_TIMEOUT``) instead of an infinite
+  block; the simulator's :class:`~flextree_tpu.backends.simulator.Mailbox`
+  carries the same contract at message granularity
+  (``FaultPlan.recv_timeout`` → ``StageTimeout``).
+- :mod:`.preemption` — preemption-aware checkpointing.  A
+  :class:`PreemptionGuard` turns SIGTERM into a "checkpoint now" fast
+  path inside ``fit``; a :class:`BackgroundSaver` moves periodic saves
+  off the step path so the rewind window stays small without stalling
+  steps on serialization + fsync.
+
+``parallel.loop.fit`` wires all three through its ``supervision=``
+argument and records every recovery event (membership epoch transitions,
+step timeouts, stragglers, preemption checkpoints) in the
+:class:`~flextree_tpu.parallel.loop.RunReport` persisted as
+``run_report.json``.  The executed proof is ``tools/chaos_runtime.py``
+(mid-run SIGKILL / SIGSTOP / SIGTERM against real processes →
+``CHAOS_RUNTIME.json``); see docs/FAILURE_MODEL.md §Runtime failures.
+"""
+
+from .preemption import BackgroundSaver, PreemptionGuard
+from .supervisor import (
+    DEAD,
+    FT_LEASE_ENV,
+    HEALTHY,
+    STRAGGLER,
+    MembershipView,
+    PeerStatus,
+    Supervisor,
+    SupervisorConfig,
+)
+from .watchdog import FT_STEP_TIMEOUT_ENV, StepTimeout, StepWatchdog
+
+__all__ = [
+    "Supervisor",
+    "SupervisorConfig",
+    "MembershipView",
+    "PeerStatus",
+    "HEALTHY",
+    "STRAGGLER",
+    "DEAD",
+    "StepWatchdog",
+    "StepTimeout",
+    "PreemptionGuard",
+    "BackgroundSaver",
+    "FT_STEP_TIMEOUT_ENV",
+    "FT_LEASE_ENV",
+]

@@ -1,0 +1,167 @@
+"""Preemption-aware checkpointing: SIGTERM → checkpoint now, saves off
+the step path.
+
+Preemptible capacity (spot VMs, TPU preemptions, kubernetes evictions)
+delivers SIGTERM with a short grace window.  Losing ``ckpt_every`` steps
+of work to every preemption makes cheap capacity expensive; the two
+pieces here shrink the rewind window from both ends:
+
+- :class:`PreemptionGuard` — an async-signal-safe SIGTERM trap.  The
+  handler only sets an event (nothing else is safe in a signal handler);
+  ``fit`` polls it every loop iteration and takes the "checkpoint now"
+  fast path — a synchronous save of the *current* state — before exiting
+  cleanly, so at most one step of work is lost (pinned by the SIGTERM
+  scenario of ``tools/chaos_runtime.py``).
+- :class:`BackgroundSaver` — periodic saves without stalling steps.
+  Serialization + fsync of a snapshot can take longer than a step; the
+  saver owns a daemon thread with a depth-1 latest-wins slot, so the
+  step loop's cost is handing over a (immutable) state pytree reference.
+  Device arrays are host-gathered on the saver thread — ``jax`` arrays
+  are immutable, so the snapshot is consistent no matter how many steps
+  run in the meantime.  A new submit while a save is in flight replaces
+  the pending one (newest state wins — exactly the checkpoint you want).
+
+Both report what they did (``triggered_at``/``saves``/``errors``) so
+``RunReport`` can account for them; neither raises into the step loop.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from ..utils.logging import get_logger
+
+__all__ = ["PreemptionGuard", "BackgroundSaver"]
+
+log = get_logger("flextree.runtime")
+
+
+class PreemptionGuard:
+    """Latch SIGTERM (by default) into a pollable "checkpoint now" flag.
+
+    ``install()`` replaces the handler (main thread only — a Python
+    constraint) and remembers the previous one; ``uninstall()`` restores
+    it.  ``trigger()`` is the in-process injection point for tests and
+    for other delivery mechanisms (e.g. a cloud metadata watcher thread).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._previous: dict[int, object] = {}
+        self.triggered_at: float | None = None
+
+    # -- delivery -----------------------------------------------------------
+
+    def _handler(self, signum, frame):
+        # async-signal-safe: set the flag, nothing else
+        self.trigger()
+
+    def trigger(self) -> None:
+        if not self._event.is_set():
+            self.triggered_at = time.time()
+        self._event.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def install(self) -> "PreemptionGuard":
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+class BackgroundSaver:
+    """Off-step-path checkpoint writer: depth-1 latest-wins save slot.
+
+    ``submit(state)`` never blocks on I/O; the daemon thread host-gathers
+    and writes via ``save_train_state`` (same rotation/integrity path as
+    synchronous saves, so restores cannot tell them apart).  ``drain()``
+    waits for the slot to empty — call it before process exit or before
+    a synchronous save of the same directory (two writers racing the
+    rotation is the one thing the design forbids).
+    """
+
+    def __init__(self, ckpt_dir: str | os.PathLike, *, max_to_keep: int = 3):
+        self.ckpt_dir = os.fspath(ckpt_dir)
+        self.max_to_keep = max_to_keep
+        self.saves = 0
+        self.dropped = 0  # submits coalesced away by latest-wins
+        self.errors: list[str] = []
+        self._pending = None
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ft-bg-ckpt"
+        )
+        self._thread.start()
+
+    def submit(self, state) -> None:
+        with self._lock:
+            if self._pending is not None:
+                self.dropped += 1
+            self._pending = state
+            self._idle.clear()
+        self._wake.set()
+
+    def _loop(self) -> None:
+        from ..utils.checkpoint import save_train_state
+
+        while True:
+            self._wake.wait()
+            with self._lock:
+                state, self._pending = self._pending, None
+                self._wake.clear()
+                if state is None and self._stop:
+                    self._idle.set()
+                    return
+            if state is None:
+                self._idle.set()
+                continue
+            try:
+                save_train_state(
+                    self.ckpt_dir, state, max_to_keep=self.max_to_keep
+                )
+                self.saves += 1
+            except Exception as e:  # never raises into the step loop
+                self.errors.append(f"{type(e).__name__}: {e}")
+                log.warning("background checkpoint failed: %s", e)
+            with self._lock:
+                if self._pending is None:
+                    self._idle.set()
+
+    def drain(self, timeout: float | None = 30.0) -> bool:
+        """Wait until no save is pending or in flight."""
+        return self._idle.wait(timeout)
+
+    def close(self, timeout: float | None = 30.0) -> None:
+        self.drain(timeout)
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+
+    def __enter__(self) -> "BackgroundSaver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -160,16 +160,68 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-every", type=int, default=100)
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--no-resume", action="store_true")
+    # runtime supervision (flextree_tpu.runtime; docs/FAILURE_MODEL.md)
+    ap.add_argument(
+        "--step-timeout", type=float, default=None, metavar="S",
+        help="per-step watchdog deadline in seconds (env FT_STEP_TIMEOUT); "
+        "a hung step raises a typed FT_STEP_TIMEOUT instead of blocking",
+    )
+    ap.add_argument(
+        "--heartbeat-dir", type=str, default=None,
+        help="shared heartbeat directory: this process beats its lease + "
+        "step progress there and watches peers (straggler/dead "
+        "classification feeds run_report.json)",
+    )
+    ap.add_argument("--heartbeat-rank", type=int, default=0,
+                    help="this process's rank in the heartbeat group")
+    ap.add_argument("--heartbeat-world", type=int, default=None,
+                    help="configured group size for membership accounting")
+    ap.add_argument(
+        "--no-preempt-checkpoint", action="store_true",
+        help="disable the SIGTERM 'checkpoint now' fast path (on by "
+        "default whenever --ckpt-dir is set)",
+    )
     args = ap.parse_args(argv)
 
     if args.cpu:
         import jax
 
+        from .utils.compat import request_cpu_devices
+
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu)
+        request_cpu_devices(args.cpu)  # both config spellings (compat shim)
 
     from .data import LMDataset, synthetic_tokens
-    from .parallel.loop import FitConfig, fit
+    from .parallel.loop import FitConfig, Supervision, fit
+
+    # runtime supervision wiring: any flag arms the layer; SIGTERM
+    # preemption checkpointing is on by default when checkpointing is
+    supervision = None
+    want_preempt = args.ckpt_dir and not args.no_preempt_checkpoint
+    if args.step_timeout or args.heartbeat_dir or want_preempt:
+        from .runtime import (
+            MembershipView,
+            PreemptionGuard,
+            Supervisor,
+            SupervisorConfig,
+        )
+
+        supervisor = membership = None
+        if args.heartbeat_dir:
+            cfg_hb = SupervisorConfig.from_env(
+                rank=args.heartbeat_rank, dir=args.heartbeat_dir
+            )
+            supervisor = Supervisor(cfg_hb)
+            membership = MembershipView.for_config(
+                cfg_hb, configured=args.heartbeat_world
+            )
+        supervision = Supervision(
+            supervisor=supervisor,
+            membership=membership,
+            configured_world=args.heartbeat_world,
+            step_timeout_s=args.step_timeout,
+            preemption=PreemptionGuard().install() if want_preempt else None,
+        )
 
     state, step_fn, mesh, sspecs = build(args)
     dataset = LMDataset(
@@ -178,26 +230,36 @@ def main(argv=None) -> int:
         seq_len=args.seq_len,
         seed=args.seed,
     )
-    result = fit(
-        state,
-        step_fn,
-        dataset,
-        FitConfig(
-            num_steps=args.steps,
-            ckpt_dir=args.ckpt_dir,
-            ckpt_every=args.ckpt_every,
-            log_every=args.log_every,
-            resume=not args.no_resume,
-        ),
-        mesh=mesh,
-        state_specs=sspecs,
-    )
+    try:
+        result = fit(
+            state,
+            step_fn,
+            dataset,
+            FitConfig(
+                num_steps=args.steps,
+                ckpt_dir=args.ckpt_dir,
+                ckpt_every=args.ckpt_every,
+                log_every=args.log_every,
+                resume=not args.no_resume,
+            ),
+            mesh=mesh,
+            state_specs=sspecs,
+            supervision=supervision,
+        )
+    finally:
+        if supervision is not None and supervision.preemption is not None:
+            supervision.preemption.uninstall()  # in-process callers (tests)
     first = result.losses[0][1] if result.losses else float("nan")
     last = result.losses[-1][1] if result.losses else float("nan")
     print(
         f"{args.model}: {result.steps_run} steps on mesh "
         f"{dict(mesh.shape)}; loss {first:.4f} -> {last:.4f}"
         + (f" (resumed from {result.resumed_from})" if result.resumed_from else "")
+        + (
+            f" (preempted at step {result.report.preempted_at}, checkpointed)"
+            if result.report.preempted_at is not None
+            else ""
+        )
     )
     return 0
 

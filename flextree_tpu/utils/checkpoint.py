@@ -7,8 +7,10 @@ gap the TPU way:
 - **Format**: one ``.npz`` per checkpoint — every pytree leaf as a named
   array plus a JSON structure descriptor, so restore needs no template
   pytree and no pickle (robust across refactors, inspectable with plain
-  NumPy).  Writes are atomic (tmp file + ``os.replace``) so a crash
-  mid-save never corrupts the latest checkpoint.
+  NumPy).  Writes are durable-atomic (tmp file fsynced + ``os.replace``
+  + directory fsync) so a crash mid-save never corrupts the latest
+  checkpoint — and the committed rename survives host crash, not just
+  process crash.
 - **Sharded restore**: ``restore_checkpoint(..., mesh=, specs=)`` places
   each leaf with ``jax.device_put`` under a ``NamedSharding``, so a
   checkpoint saved from one mesh resumes on another (e.g. 8 -> 16 chips,
@@ -134,10 +136,35 @@ def _restore_dtype(a: np.ndarray, dtype_str: str | None) -> np.ndarray:
     return a.astype(target)
 
 
+def _fsync_dir(dirpath: str) -> None:
+    """fsync a directory so a just-committed rename survives HOST crash.
+
+    ``os.replace`` makes the swap atomic against *process* crash, but the
+    new directory entry lives in the directory inode — on a power loss
+    before the directory block hits disk, the filesystem can replay to a
+    state where neither the tmp file nor the renamed checkpoint exists.
+    Fsyncing the containing directory after the replace closes that
+    window (the file's own data was fsynced before the rename).
+    """
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platforms without O_RDONLY dirs
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - fs without dir fsync (FUSE/NFS):
+        pass  # the checkpoint itself is already committed; degrade quietly
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(path: str | os.PathLike, tree) -> str:
     """Write ``tree`` (dict/list/tuple pytree of arrays) to ``path``.
 
-    Device arrays are host-gathered first; the write is atomic.
+    Device arrays are host-gathered first; the write is durable-atomic:
+    tmp file fsynced, ``os.replace``, then the containing directory
+    fsynced — so the newest checkpoint survives host crash, not just
+    process crash (docs/FAILURE_MODEL.md).
     """
     path = os.fspath(path)
     tree = jax.device_get(tree)
@@ -147,14 +174,16 @@ def save_checkpoint(path: str | os.PathLike, tree) -> str:
     arrays["__structure__"] = np.frombuffer(
         json.dumps(structure).encode(), dtype=np.uint8
     )
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    fd, tmp = tempfile.mkstemp(
-        dir=os.path.dirname(path) or ".", suffix=".npz.tmp"
-    )
+    dirpath = os.path.dirname(path) or "."
+    os.makedirs(dirpath, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirpath, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(dirpath)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

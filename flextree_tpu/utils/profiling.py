@@ -38,9 +38,60 @@ __all__ = [
     "phase_timer",
     "PhaseTimer",
     "comm_span",
+    "Ewma",
+    "step_scope",
     "debug_dump_schedule",
     "debug_enabled",
 ]
+
+
+class Ewma:
+    """Exponentially-weighted moving average — the per-rank step-duration
+    signal the runtime supervision layer classifies stragglers from.
+
+    Each rank folds its step wall-times into an EWMA (``alpha`` weights
+    the newest sample) and publishes it in its heartbeat
+    (``runtime.supervisor.Supervisor``); the coordinator's
+    ``MembershipView`` flags ranks whose EWMA is an outlier against the
+    peer median.  An EWMA rather than the last sample so one noisy step
+    (GC pause, page fault) doesn't flap the classification.
+    """
+
+    def __init__(self, alpha: float = 0.2):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = alpha
+        self.value: float | None = None
+        self.count = 0
+
+    def update(self, sample: float) -> float:
+        self.value = (
+            sample
+            if self.value is None
+            else self.alpha * sample + (1.0 - self.alpha) * self.value
+        )
+        self.count += 1
+        return self.value
+
+
+@contextlib.contextmanager
+def step_scope(ewma: "Ewma | None" = None, on_duration=None):
+    """Time one host-level training step; feed the duration to an
+    :class:`Ewma` and/or ``on_duration(seconds)`` (e.g.
+    ``Supervisor.record_step`` partial) on exit.  The host-side sibling
+    of :func:`comm_span`: ``comm_span`` names device spans inside jitted
+    code, ``step_scope`` accounts the wall-clock of the whole dispatched
+    step — the quantity the straggler classifier compares across ranks.
+    """
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if ewma is not None:
+            ewma.update(dt)
+        if on_duration is not None:
+            on_duration(dt)
 
 
 @contextlib.contextmanager
@@ -53,7 +104,7 @@ def comm_span(name: str, timer: "PhaseTimer | None" = None):
     This is the per-*bucket* observability layer the fused gradient sync
     uses (``parallel.bucketing``): each bucket's collectives trace under an
     ``ft_bucket{i}_{axis}_{k}leaves_{bytes}B`` range, so a profile (or a
-    RUN_REPORT built from one) can attribute comm time per bucket and
+    run_report built from one) can attribute comm time per bucket and
     separate comm from compute per step.  Under ``jit`` the body runs at
     trace time, so the *timer* measures tracing, not execution — pass a
     timer only in eager/host-level phases; inside jitted code the named

@@ -36,9 +36,10 @@ from flextree_tpu.backends import (
     Fault,
     FaultDetected,
     FaultPlan,
+    StageTimeout,
     simulate_allreduce,
 )
-from flextree_tpu.backends.simulator import WHOLE_PAYLOAD
+from flextree_tpu.backends.simulator import WHOLE_PAYLOAD, ScheduleViolation
 from flextree_tpu.parallel.loop import FitConfig, TrainingDiverged, fit
 from flextree_tpu.utils.checkpoint import (
     CheckpointCorrupt,
@@ -135,6 +136,48 @@ def test_killed_rank_detected_by_surviving_peer(n, topo):
     assert e.kind == "kill"
     assert e.src == 1
     assert "rank 1 died at stage 0" in str(e)
+
+
+@pytest.mark.parametrize("n,topo", TOPOS)
+def test_hung_sender_times_out_typed_with_recv_deadline(n, topo):
+    """The in-run straggler/hang class (ISSUE 4): a sender that stalls
+    mid-stage (SIGSTOP signature — never posts, never dies) must surface
+    as a typed StageTimeout carrying FT_STEP_TIMEOUT and the exact
+    coordinates, when the mailbox runs deadline-wrapped."""
+    data = RNG.standard_normal((n, 64))
+    plan = FaultPlan(
+        faults=(Fault("hang", stage=0, src=0, dst=1),), recv_timeout=1.5
+    )
+    with pytest.raises(StageTimeout) as ei:
+        simulate_allreduce(data, topo, faults=plan)
+    e = ei.value
+    assert e.kind == "hang"
+    assert e.code == "FT_STEP_TIMEOUT"
+    assert (e.stage, e.src, e.dst) == (0, 0, 1)
+    assert e.timeout_s == 1.5
+    assert "FT_STEP_TIMEOUT" in str(e) and "recv deadline" in str(e)
+    actions = {ev.action for ev in plan.events if ev.kind == "hang"}
+    assert {"injected", "detected"} <= actions, plan.events
+
+
+def test_hang_without_recv_deadline_is_refused_not_silent():
+    """Without a recv deadline a hang would block forever on real
+    hardware; the simulator refuses to model that silently and names the
+    missing watchdog — the detect-or-recover contract has no third
+    'hang forever quietly' outcome."""
+    data = RNG.standard_normal((8, 64))
+    plan = FaultPlan(faults=(Fault("hang", stage=0, src=0, dst=1),))
+    with pytest.raises(ScheduleViolation, match="block FOREVER.*recv deadline"):
+        simulate_allreduce(data, "4,2", faults=plan)
+
+
+def test_blanket_hang_detected_on_first_needed_message():
+    """Wildcard-hang every message: with the deadline configured the run
+    must end in StageTimeout — never a wrong result."""
+    data = RNG.standard_normal((8, 32))
+    plan = FaultPlan(faults=(Fault("hang"),), recv_timeout=0.5)
+    with pytest.raises(StageTimeout):
+        simulate_allreduce(data, "2,2,2", faults=plan)
 
 
 def test_lonely_fold_hop_is_chaos_reachable():
@@ -299,7 +342,7 @@ def _expected_w(applied_steps):
 def test_nan_step_is_skipped_and_counted(tmp_path):
     """Acceptance (a): an injected NaN loss at step k is skipped — the
     poisoned update is discarded, the run completes, and the RunReport
-    (returned and persisted as RUN_REPORT.json) carries the accounting."""
+    (returned and persisted as run_report.json) carries the accounting."""
     ck = str(tmp_path / "ck")
     res = fit(
         _w0(), _toy_step(poison={3}), _ToyData(),
@@ -311,7 +354,7 @@ def test_nan_step_is_skipped_and_counted(tmp_path):
     np.testing.assert_allclose(
         res.state["w"], _expected_w(s for s in range(8) if s != 3)
     )
-    with open(os.path.join(ck, "RUN_REPORT.json")) as f:
+    with open(os.path.join(ck, "run_report.json")) as f:
         persisted = json.load(f)
     assert persisted["anomalies"] == 1 and persisted["skipped_steps"] == [3]
 
@@ -359,7 +402,7 @@ def test_persistent_divergence_raises_after_rewind_budget(tmp_path):
 
 
 def test_run_report_persisted_when_training_diverges(tmp_path):
-    """The accounting matters most for the run that dies: RUN_REPORT.json
+    """The accounting matters most for the run that dies: run_report.json
     must exist (anomalies + rewinds recorded) after TrainingDiverged."""
     ck = str(tmp_path / "ck")
 
@@ -375,7 +418,7 @@ def test_run_report_persisted_when_training_diverges(tmp_path):
                 max_bad_steps=3, max_rewinds=1,
             ),
         )
-    with open(os.path.join(ck, "RUN_REPORT.json")) as f:
+    with open(os.path.join(ck, "run_report.json")) as f:
         persisted = json.load(f)
     assert persisted["rewinds"] == 1
     assert persisted["anomalies"] == 6  # 3 before the rewind, 3 after
@@ -587,3 +630,64 @@ def test_chaos_bringup_kill_restart_degrade():
     assert p.returncode == 0, f"chaos bring-up failed:\n{p.stdout[-4000:]}"
     for scenario in ("retry", "restart", "degrade"):
         assert f"scenario {scenario}: OK" in p.stdout, p.stdout[-4000:]
+
+
+def _load_tool(name):
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_bringup_exits_nonzero_on_unrecovered_scenario(monkeypatch):
+    """The CI gate: a scenario that fails to recover — or whose driver
+    crashes outright — must surface as a non-zero exit, never a green
+    exit with a failed scenario buried in the JSON."""
+    cb = _load_tool("chaos_bringup")
+    failed = {"scenario": "retry", "ok": False, "returncodes": [1],
+              "reports": [], "logs": [["[proc 1] FAIL: injected"]]}
+    monkeypatch.setattr(cb, "run_retry", lambda port: failed)
+    assert cb.main(["--scenario", "retry", "--no-artifact"]) == 1
+    monkeypatch.setattr(cb, "run_retry", lambda port: {**failed, "ok": True})
+    assert cb.main(["--scenario", "retry", "--no-artifact"]) == 0
+
+    def crash(port):
+        raise RuntimeError("driver exploded")
+
+    monkeypatch.setattr(cb, "run_retry", crash)
+    assert cb.main(["--scenario", "retry", "--no-artifact"]) == 1
+
+
+def test_chaos_runtime_exits_nonzero_on_unrecovered_scenario(monkeypatch):
+    """Same gate for the runtime driver (tools/chaos_runtime.py)."""
+    cr = _load_tool("chaos_runtime")
+    ok = {"scenario": "sigterm", "recovered": True, "checks": {}, "log": []}
+    monkeypatch.setattr(cr, "run_sigterm", lambda wd: ok)
+    assert cr.main(["--scenario", "sigterm", "--no-artifact"]) == 0
+    monkeypatch.setattr(
+        cr, "run_sigterm", lambda wd: {**ok, "recovered": False}
+    )
+    assert cr.main(["--scenario", "sigterm", "--no-artifact"]) == 1
+
+
+@pytest.mark.slow
+def test_chaos_runtime_sigkill_sigstop_sigterm():
+    """The runtime chaos matrix, executed against real processes and real
+    signals: mid-run SIGKILL -> live shrink-to-survivors resume; SIGSTOP
+    -> straggler flagged within the lease budget (no shrink); SIGTERM ->
+    preemption checkpoint within one step + exact resume.  The committed
+    CHAOS_RUNTIME.json is this run's artifact form."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_runtime.py"),
+         "--no-artifact"],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        cwd=REPO,
+    )
+    assert p.returncode == 0, f"runtime chaos failed:\n{p.stdout[-4000:]}"
+    for scenario in ("sigkill", "sigstop", "sigterm"):
+        assert f"scenario {scenario}: RECOVERED" in p.stdout, p.stdout[-4000:]

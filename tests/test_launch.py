@@ -176,3 +176,109 @@ class TestPlannerBridge:
             jax.device_get(allreduce_over_mesh(jnp.asarray(x), flat, topo=topo))
         )
         np.testing.assert_allclose(out, np.tile(x.sum(0), (8, 1)), rtol=1e-5)
+
+
+class TestBringupErrorTaxonomy:
+    """The failure-path contract of the retry wrapper: FT_INIT_TIMEOUT /
+    FT_INIT_RETRIES env knobs, attempt counts, and the error strings
+    accumulated on BringupReport / BringupTimeout (previously only the
+    happy/degrade paths were pinned here)."""
+
+    def _clean_env(self, monkeypatch):
+        for var in ("FT_COORDINATOR", "FT_NUM_PROCESSES", "FT_PROCESS_ID",
+                    "FT_INIT_TIMEOUT", "FT_INIT_RETRIES"):
+            monkeypatch.delenv(var, raising=False)
+
+    def test_hierarchy(self):
+        from flextree_tpu.parallel.launch import (
+            BringupConfigError,
+            BringupError,
+            BringupTimeout,
+        )
+
+        assert issubclass(BringupConfigError, BringupError)
+        assert issubclass(BringupTimeout, BringupError)
+        assert issubclass(BringupError, RuntimeError)
+        e = BringupTimeout("msg", attempts=3, errors=["a", "b", "c"])
+        assert e.attempts == 3 and e.errors == ["a", "b", "c"]
+
+    def test_env_knobs_drive_budget_and_deadline(self, monkeypatch):
+        """FT_INIT_RETRIES sets the retry budget, FT_INIT_TIMEOUT the
+        per-attempt handshake deadline forwarded as
+        initialization_timeout (and the pre-handshake probe budget)."""
+        from flextree_tpu.parallel import launch as launch_mod
+        from flextree_tpu.parallel.launch import (
+            BringupTimeout,
+            ClusterConfig,
+            init_distributed,
+        )
+
+        self._clean_env(monkeypatch)
+        monkeypatch.setenv("FT_INIT_RETRIES", "4")
+        monkeypatch.setenv("FT_INIT_TIMEOUT", "9")
+        monkeypatch.setattr(launch_mod, "_sleep", lambda s: None)
+        probes, calls = [], []
+        monkeypatch.setattr(
+            launch_mod, "_probe_coordinator", lambda c, b: probes.append((c, b))
+        )
+
+        def doomed(**kw):
+            calls.append(kw)
+            raise RuntimeError("connect refused")
+
+        monkeypatch.setattr(launch_mod.jax.distributed, "initialize", doomed)
+        with pytest.raises(BringupTimeout) as ei:
+            init_distributed(ClusterConfig("h0:1234", 2, 1))
+        assert ei.value.attempts == 5  # first try + FT_INIT_RETRIES
+        assert all(kw["initialization_timeout"] == 9 for kw in calls)
+        assert all(budget == 9.0 for _, budget in probes)
+
+    def test_timeout_message_and_accumulated_errors(self, monkeypatch):
+        from flextree_tpu.parallel import launch as launch_mod
+        from flextree_tpu.parallel.launch import (
+            BringupTimeout,
+            ClusterConfig,
+            init_distributed,
+        )
+
+        self._clean_env(monkeypatch)
+        monkeypatch.setattr(launch_mod, "_sleep", lambda s: None)
+        attempts = []
+
+        def doomed(**kw):
+            attempts.append(1)
+            raise OSError(f"connect refused #{len(attempts)}")
+
+        monkeypatch.setattr(launch_mod.jax.distributed, "initialize", doomed)
+        with pytest.raises(BringupTimeout) as ei:
+            init_distributed(ClusterConfig("h0:1234", 2, 0), retries=2)
+        e = ei.value
+        # the message names the attempt count and the last error
+        assert "failed after 3 attempt(s)" in str(e)
+        assert "connect refused #3" in str(e)
+        # every attempt's error is accumulated, typed and ordered
+        assert e.errors == [
+            f"OSError: connect refused #{i}" for i in (1, 2, 3)
+        ]
+
+    def test_success_report_carries_attempts_and_errors(self, monkeypatch):
+        """A bring-up that recovers still reports what it went through:
+        BringupReport.attempts/errors are the audit trail."""
+        from flextree_tpu.parallel import launch as launch_mod
+        from flextree_tpu.parallel.launch import ClusterConfig, init_distributed
+
+        self._clean_env(monkeypatch)
+        monkeypatch.setattr(launch_mod, "_sleep", lambda s: None)
+        calls = []
+
+        def flaky(**kw):
+            calls.append(kw)
+            if len(calls) < 3:
+                raise TimeoutError("handshake deadline")
+
+        monkeypatch.setattr(launch_mod.jax.distributed, "initialize", flaky)
+        report = init_distributed(ClusterConfig("h0:1234", 2, 0), retries=5)
+        assert report.attempts == 3
+        assert report.errors == ["TimeoutError: handshake deadline"] * 2
+        assert report.elapsed_s >= 0.0
+        assert report.degraded_to is None

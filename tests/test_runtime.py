@@ -1,0 +1,472 @@
+"""Tier-1 coverage for the runtime-supervision layer (``flextree_tpu.runtime``
++ ``fit(supervision=...)``).
+
+Everything here is single-process and fast: heartbeat classification
+drives an injectable wall clock, membership death is injected through a
+fake liveness source, and the watchdog/preemption paths use synthetic
+stalls — the same machinery exercised against *real* processes and
+signals by ``tools/chaos_runtime.py`` (the ``slow``-marked scenario test
+in ``test_chaos.py`` + the committed ``CHAOS_RUNTIME.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from flextree_tpu.parallel.loop import (
+    FitConfig,
+    RunReport,
+    ShrinkExhausted,
+    Supervision,
+    fit,
+)
+from flextree_tpu.runtime import (
+    DEAD,
+    HEALTHY,
+    STRAGGLER,
+    BackgroundSaver,
+    MembershipView,
+    PreemptionGuard,
+    StepTimeout,
+    StepWatchdog,
+    Supervisor,
+    SupervisorConfig,
+)
+from flextree_tpu.utils.checkpoint import latest_checkpoint, list_checkpoints
+from flextree_tpu.utils.profiling import Ewma, step_scope
+
+pytestmark = pytest.mark.chaos
+
+
+# ------------------------------------------------------------- ewma/scope
+
+
+class TestEwma:
+    def test_first_sample_is_value(self):
+        e = Ewma(alpha=0.5)
+        assert e.update(10.0) == 10.0
+        assert e.update(20.0) == 15.0
+        assert e.count == 2
+
+    def test_alpha_validated(self):
+        with pytest.raises(ValueError, match="alpha"):
+            Ewma(alpha=0.0)
+
+    def test_step_scope_feeds_both_sinks(self):
+        e = Ewma()
+        seen = []
+        with step_scope(e, on_duration=seen.append):
+            pass
+        assert e.count == 1 and len(seen) == 1
+        assert seen[0] >= 0.0
+
+
+# ------------------------------------------------------- heartbeats/leases
+
+
+def _fake_clock(module, monkeypatch, start=1000.0):
+    """Inject a controllable wall clock into the supervisor module."""
+    state = {"now": start}
+    monkeypatch.setattr(module, "_wall", lambda: state["now"])
+    return state
+
+
+class TestHeartbeats:
+    def test_beat_roundtrip_and_healthy(self, tmp_path):
+        sup = Supervisor(SupervisorConfig(rank=2, dir=str(tmp_path)))
+        sup.record_step(7, 0.05)
+        sup.beat_now()
+        view = MembershipView(str(tmp_path))
+        statuses = view.poll()
+        assert list(statuses) == [2]
+        st = statuses[2]
+        assert st.state == HEALTHY and st.step == 7
+        assert st.ewma_ms == pytest.approx(50.0)
+        assert st.pid == os.getpid()
+
+    def test_lease_age_classifies_straggler_then_dead(self, tmp_path, monkeypatch):
+        from flextree_tpu.runtime import supervisor as S
+
+        clock = _fake_clock(S, monkeypatch)
+        sup = Supervisor(
+            SupervisorConfig(rank=0, dir=str(tmp_path), straggler_s=1.0, lease_s=3.0)
+        )
+        sup.beat_now()
+        view = MembershipView(str(tmp_path), straggler_s=1.0, lease_s=3.0)
+        assert view.poll()[0].state == HEALTHY
+        clock["now"] += 2.0  # stale past straggler_s, inside the lease
+        assert view.poll()[0].state == STRAGGLER
+        clock["now"] += 2.0  # lease expired
+        assert view.poll()[0].state == DEAD
+
+    def test_never_beaten_rank_is_dead_via_roster(self, tmp_path):
+        Supervisor(SupervisorConfig(rank=0, dir=str(tmp_path))).beat_now()
+        view = MembershipView(str(tmp_path), configured=3)
+        statuses = view.poll()
+        assert statuses[0].state == HEALTHY
+        assert statuses[1].state == DEAD and statuses[2].state == DEAD
+        assert view.alive_count() == 1
+        assert view.dead() == [1, 2]
+
+    def test_ewma_outlier_is_straggler(self, tmp_path):
+        for rank, ms in ((0, 10.0), (1, 11.0), (2, 95.0)):
+            sup = Supervisor(SupervisorConfig(rank=rank, dir=str(tmp_path)))
+            sup.record_step(5, ms / 1e3)
+            sup.beat_now()
+        view = MembershipView(str(tmp_path), ewma_factor=3.0)
+        statuses = view.poll()
+        assert statuses[0].state == HEALTHY and statuses[1].state == HEALTHY
+        assert statuses[2].state == STRAGGLER
+        assert view.stragglers() == [2]
+
+    def test_ewma_outlier_detected_in_two_rank_group(self, tmp_path):
+        """The median must be over the OTHER ranks' EWMAs: with the
+        candidate included, a 2-rank world's upper median is the slow
+        rank's own value and no straggler can ever be flagged."""
+        for rank, ms in ((0, 10.0), (1, 120.0)):
+            sup = Supervisor(SupervisorConfig(rank=rank, dir=str(tmp_path)))
+            sup.record_step(5, ms / 1e3)
+            sup.beat_now()
+        view = MembershipView(str(tmp_path), ewma_factor=3.0)
+        statuses = view.poll()
+        assert statuses[0].state == HEALTHY
+        assert statuses[1].state == STRAGGLER
+
+    def test_thread_beats_without_record_step(self, tmp_path):
+        with Supervisor(
+            SupervisorConfig(rank=0, dir=str(tmp_path), interval_s=0.02)
+        ):
+            time.sleep(0.1)
+        view = MembershipView(str(tmp_path))
+        assert view.poll()[0].state == HEALTHY
+
+    def test_beat_survives_torn_reader(self, tmp_path):
+        """A junk file in the beat dir must not break classification."""
+        (tmp_path / "hb_00009.json").write_text("{not json")
+        Supervisor(SupervisorConfig(rank=1, dir=str(tmp_path))).beat_now()
+        assert MembershipView(str(tmp_path)).poll()[1].state == HEALTHY
+
+    def test_env_knobs_drive_thresholds(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FT_LEASE", "9.5")
+        monkeypatch.setenv("FT_STRAGGLER", "4.5")
+        cfg = SupervisorConfig.from_env(rank=0, dir=str(tmp_path))
+        assert cfg.lease_s == 9.5 and cfg.straggler_s == 4.5
+
+
+# ------------------------------------------------------------- watchdog
+
+
+class TestStepWatchdog:
+    def test_result_and_exception_pass_through(self):
+        with StepWatchdog() as wd:
+            assert wd.run(lambda a, b: a + b, 2, 3, timeout_s=5.0) == 5
+            with pytest.raises(KeyError, match="boom"):
+                wd.run(lambda: (_ for _ in ()).throw(KeyError("boom")),
+                       timeout_s=5.0)
+
+    def test_timeout_is_typed_ft_step_timeout(self):
+        with StepWatchdog() as wd:
+            with pytest.raises(StepTimeout, match="FT_STEP_TIMEOUT") as ei:
+                wd.run(time.sleep, 5.0, timeout_s=0.05, step=412)
+            assert ei.value.step == 412
+            assert ei.value.timeout_s == 0.05
+            assert ei.value.code == "FT_STEP_TIMEOUT"
+            assert "step 412" in str(ei.value)
+
+    def test_stuck_worker_abandoned_next_call_clean(self):
+        with StepWatchdog() as wd:
+            with pytest.raises(StepTimeout):
+                wd.run(time.sleep, 2.0, timeout_s=0.05)
+            # one hang must not poison the watchdog: a fresh worker serves
+            assert wd.run(lambda: "alive", timeout_s=5.0) == "alive"
+            assert wd.abandoned == 1
+
+    def test_none_timeout_runs_inline(self):
+        wd = StepWatchdog()
+        assert wd.run(lambda: "inline", timeout_s=None) == "inline"
+        assert wd._worker is None  # never spawned a thread
+        wd.close()
+
+
+# ------------------------------------------------------------ preemption
+
+
+class TestPreemptionGuard:
+    def test_sigterm_latches_flag_and_restores_handler(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard() as g:
+            assert not g.preempted
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 2.0
+            while not g.preempted and time.time() < deadline:
+                time.sleep(0.01)
+            assert g.preempted
+            assert g.triggered_at is not None
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_trigger_is_idempotent(self):
+        g = PreemptionGuard()
+        g.trigger()
+        first = g.triggered_at
+        g.trigger()
+        assert g.triggered_at == first
+
+
+class TestBackgroundSaver:
+    def _state(self, step):
+        return {"step": np.int64(step), "w": np.ones(4) * step}
+
+    def test_saves_land_and_coalesce(self, tmp_path):
+        with BackgroundSaver(tmp_path, max_to_keep=5) as bs:
+            for s in (2, 4, 6, 8):
+                bs.submit(self._state(s))
+            assert bs.drain(timeout=10)
+        steps = [s for s, _ in list_checkpoints(tmp_path)]
+        # latest-wins: the newest submit is always persisted; earlier ones
+        # may coalesce away but never reorder past it
+        assert steps and steps[-1] == 8
+        assert bs.saves + bs.dropped == 4
+        assert bs.errors == []
+
+    def test_save_error_recorded_not_raised(self, tmp_path):
+        bs = BackgroundSaver(tmp_path / "dir")
+        bs.submit({"no_step_key": np.ones(2)})  # save_train_state will raise
+        bs.close()
+        assert bs.saves == 0 and len(bs.errors) == 1
+
+
+# -------------------------------------------------- fit + supervision
+
+
+class _ToyData:
+    def batch_at(self, step):
+        tok = np.full((2, 4), float(step + 1))
+        return tok, tok
+
+
+def _toy_step(stall_once=None, stall_s=0.6, on_step=None):
+    """w -= 0.01*mean(batch); optionally stalls (once) at given steps."""
+    stall_once = set(stall_once or ())
+
+    def step_fn(state, tokens, targets):
+        s = int(np.asarray(state["step"]))
+        if on_step is not None:
+            on_step(s)
+        if s in stall_once:
+            stall_once.discard(s)
+            time.sleep(stall_s)
+        g = float(tokens.mean())
+        return (
+            {"step": np.int64(s + 1), "w": np.asarray(state["w"]) - 0.01 * g},
+            {"loss": g},
+        )
+
+    return step_fn
+
+
+def _w0():
+    return {"step": np.int64(0), "w": np.zeros(4, dtype=np.float64)}
+
+
+def _expected_w(steps):
+    return -0.01 * sum(s + 1 for s in steps) * np.ones(4)
+
+
+class TestFitSupervision:
+    def test_unsupervised_loop_untouched(self, tmp_path):
+        """supervision=None must keep the historical loop (and report)."""
+        res = fit(_w0(), _toy_step(), _ToyData(),
+                  FitConfig(num_steps=4, log_every=0))
+        assert res.steps_run == 4
+        assert res.report.step_timeouts == 0
+        assert res.report.membership_epochs == []
+
+    def test_step_timeout_retried_then_exact(self, tmp_path):
+        """A transient stall -> typed timeout -> bounded retry of the SAME
+        step; the final parameters match an undisturbed run exactly."""
+        res = fit(
+            _w0(), _toy_step(stall_once={3}), _ToyData(),
+            FitConfig(num_steps=6, ckpt_dir=str(tmp_path / "ck"), log_every=0),
+            supervision=Supervision(step_timeout_s=0.2, max_step_retries=1),
+        )
+        assert res.steps_run == 6
+        assert res.report.step_timeouts == 1
+        assert res.report.step_retries == 1
+        np.testing.assert_allclose(res.state["w"], _expected_w(range(6)))
+
+    def test_step_timeout_exhausted_raises_typed(self, tmp_path):
+        def hang_forever(state, tokens, targets):
+            time.sleep(30)
+
+        with pytest.raises(StepTimeout, match="FT_STEP_TIMEOUT"):
+            fit(
+                _w0(), hang_forever, _ToyData(),
+                FitConfig(num_steps=4, log_every=0),
+                supervision=Supervision(step_timeout_s=0.1, max_step_retries=1),
+            )
+
+    def test_step_timeout_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FT_STEP_TIMEOUT", "0.1")
+        with pytest.raises(StepTimeout):
+            fit(
+                _w0(), lambda *a: time.sleep(30), _ToyData(),
+                FitConfig(num_steps=2, log_every=0),
+                supervision=Supervision(max_step_retries=0),
+            )
+
+    def test_confirmed_death_shrinks_to_survivors(self, tmp_path):
+        """The live-shrink path: a dead peer -> restore the latest verified
+        checkpoint, replan for the survivors, rebuild via on_shrink, resume
+        to completion — membership epochs record the transition."""
+        ck = str(tmp_path / "ck")
+        calls = {"n": 0}
+
+        def membership():
+            calls["n"] += 1
+            st = {r: "healthy" for r in range(4)}
+            if calls["n"] > 6:  # rank 3 dies mid-run
+                st[3] = "dead"
+            return st
+
+        rebuilt = []
+
+        def on_shrink(n_alive, plan):
+            rebuilt.append((n_alive, plan.to_ft_topo()))
+            return None  # keep the toy step; the replan is what we pin
+
+        res = fit(
+            _w0(), _toy_step(), _ToyData(),
+            FitConfig(num_steps=10, ckpt_dir=ck, ckpt_every=2, log_every=0),
+            supervision=Supervision(
+                membership=membership, configured_world=4,
+                on_shrink=on_shrink, nbytes_hint=1 << 20,
+            ),
+        )
+        assert res.steps_run == 10
+        epochs = res.report.membership_epochs
+        assert len(epochs) == 2
+        assert epochs[0]["alive"] == 4 and epochs[0]["configured"] == 4
+        assert epochs[1]["alive"] == 3 and epochs[1]["dead"] == [3]
+        assert epochs[1]["topo"] is not None  # replanned for 3 survivors
+        assert rebuilt == [(3, epochs[1]["topo"])]
+        # restore + deterministic replay: exact parameters
+        np.testing.assert_allclose(res.state["w"], _expected_w(range(10)))
+
+    def test_on_shrink_can_swap_the_step_fn(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        polls = {"n": 0}
+
+        def membership():
+            polls["n"] += 1
+            return {0: "healthy", 1: "dead" if polls["n"] > 4 else "healthy"}
+
+        ran_after = []
+
+        def on_shrink(n_alive, plan):
+            return _toy_step(on_step=ran_after.append), None, None
+
+        res = fit(
+            _w0(), _toy_step(), _ToyData(),
+            FitConfig(num_steps=8, ckpt_dir=ck, ckpt_every=2, log_every=0),
+            supervision=Supervision(
+                membership=membership, configured_world=2, on_shrink=on_shrink
+            ),
+        )
+        assert res.steps_run == 8
+        assert ran_after, "the rebuilt step never ran after the shrink"
+        np.testing.assert_allclose(res.state["w"], _expected_w(range(8)))
+
+    def test_shrink_budget_exhaustion_is_typed(self, tmp_path):
+        def membership():
+            return {0: "healthy", 1: "dead"}
+
+        with pytest.raises(ShrinkExhausted, match="max_shrinks"):
+            fit(
+                _w0(), _toy_step(), _ToyData(),
+                FitConfig(num_steps=8, log_every=0),
+                supervision=Supervision(
+                    membership=membership, configured_world=2, max_shrinks=0
+                ),
+            )
+
+    def test_straggler_recorded_once_no_shrink(self, tmp_path):
+        def membership():
+            return {0: "healthy", 1: "straggler"}
+
+        res = fit(
+            _w0(), _toy_step(), _ToyData(),
+            FitConfig(num_steps=6, log_every=0),
+            supervision=Supervision(membership=membership, configured_world=2),
+        )
+        assert res.report.stragglers == [{"rank": 1, "step": 0}]
+        assert len(res.report.membership_epochs) == 1  # stall != death
+
+    def test_preemption_checkpoints_within_one_step(self, tmp_path):
+        """The SIGTERM fast path: flag observed -> synchronous checkpoint of
+        the CURRENT state -> clean exit; resume is exact."""
+        ck = str(tmp_path / "ck")
+        guard = PreemptionGuard()  # triggered in-process, no real signal
+
+        def trigger_at_4(s):
+            if s == 4:
+                guard.trigger()
+
+        res = fit(
+            _w0(), _toy_step(on_step=trigger_at_4), _ToyData(),
+            FitConfig(num_steps=20, ckpt_dir=ck, ckpt_every=100, log_every=0),
+            supervision=Supervision(preemption=guard),
+        )
+        assert res.report.preempted_at == 5  # the in-flight step completed
+        assert res.steps_run == 5
+        ckpt = latest_checkpoint(ck)
+        assert ckpt and "00000005" in ckpt
+        resumed = fit(
+            _w0(), _toy_step(), _ToyData(),
+            FitConfig(num_steps=20, ckpt_dir=ck, ckpt_every=100, log_every=0),
+        )
+        assert resumed.resumed_from == 5
+        np.testing.assert_allclose(resumed.state["w"], _expected_w(range(20)))
+
+    def test_background_saver_keeps_rewind_window_small(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        bs = BackgroundSaver(ck)
+        res = fit(
+            _w0(), _toy_step(), _ToyData(),
+            FitConfig(num_steps=9, ckpt_dir=ck, ckpt_every=2, log_every=0),
+            supervision=Supervision(background_saver=bs),
+        )
+        bs.close()
+        assert res.report.background_saves >= 1
+        steps = [s for s, _ in list_checkpoints(ck)]
+        assert steps[-1] == 9  # the final synchronous save, post-drain
+        # a background-saved checkpoint restores like any other
+        resumed = fit(
+            _w0(), _toy_step(), _ToyData(),
+            FitConfig(num_steps=12, ckpt_dir=ck, ckpt_every=100, log_every=0),
+        )
+        assert resumed.resumed_from == 9
+        np.testing.assert_allclose(resumed.state["w"], _expected_w(range(12)))
+
+    def test_run_report_json_machine_readable(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        fit(
+            _w0(), _toy_step(stall_once={2}), _ToyData(),
+            FitConfig(num_steps=5, ckpt_dir=ck, log_every=0),
+            supervision=Supervision(step_timeout_s=0.2),
+        )
+        with open(os.path.join(ck, "run_report.json")) as f:
+            persisted = json.load(f)
+        for key in ("step_timeouts", "step_retries", "stragglers",
+                    "membership_epochs", "preempted_at", "background_saves"):
+            assert key in persisted
+        assert persisted["step_timeouts"] == 1
+        # to_json is the same serialization fit used
+        assert json.loads(RunReport(**{
+            k: v for k, v in persisted.items()
+        }).to_json()) == persisted

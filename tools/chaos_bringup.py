@@ -304,14 +304,14 @@ def _summarize(name: str, logs, rcs, expect_pass: int) -> dict:
     }
 
 
-def main() -> int:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--port", type=int, default=19930)
     ap.add_argument("--scenario", choices=SCENARIOS, action="append")
     ap.add_argument("--out", default=os.path.join(REPO, "CHAOS_BRINGUP.json"))
     ap.add_argument("--no-artifact", action="store_true")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     if args.child:
         return child_main()
 
@@ -320,12 +320,26 @@ def main() -> int:
     results = []
     for i, name in enumerate(which):
         print(f"=== scenario {name} ===", flush=True)
-        res = runners[name](args.port + i)
+        try:
+            res = runners[name](args.port + i)
+        except Exception as e:
+            # a crashed driver is a FAILED scenario, recorded in the
+            # artifact and reflected in the exit code — never a scenario
+            # that silently vanishes from the JSON while the tool exits 0
+            res = {
+                "scenario": name,
+                "ok": False,
+                "returncodes": [],
+                "reports": [],
+                "logs": [[f"driver error: {type(e).__name__}: {e}"]],
+            }
         results.append(res)
         print(f"scenario {name}: {'OK' if res['ok'] else 'FAIL'}", flush=True)
         for l in res["logs"]:
             for line in l:
                 print(f"  {line}")
+    # the gate CI relies on: ANY scenario failing to recover -> exit 1,
+    # with the artifact still written below so the postmortem has it
     ok = all(r["ok"] for r in results)
 
     if not args.no_artifact:
