@@ -424,6 +424,75 @@ with open(os.path.join(ck, "run_report.json")) as f:
 '''
 
 
+_QUANT_TRIPWIRE_CODE = r'''
+import json, os, sys, tempfile
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from flextree_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(8)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from flextree_tpu.ops.quantize import get_codec
+from flextree_tpu.parallel.compressed import compressed_allreduce
+from flextree_tpu.parallel.mesh import flat_mesh
+
+mesh = flat_mesh(8, "ft")
+rng = np.random.default_rng(7)
+x = jnp.asarray(rng.standard_normal((8, 8192)).astype(np.float32) * 2)
+exact = np.asarray(x).astype(np.float64).sum(axis=0)
+amax = float(np.abs(np.asarray(x)).max())
+violations = 0
+for codec, topo, widths in (
+    ("int8", "4,2", (4, 2)), ("int8", "1", (1,)), ("bf16", "4,2", (4, 2)),
+):
+    f = lambda row: compressed_allreduce(
+        row[0], "ft", topo=topo, codec=codec, step=11)[None]
+    out = np.asarray(jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"), check_vma=False
+    ))(x))
+    bound = get_codec(codec).error_bound(amax, 8, widths) + 1e-5
+    violations += int(np.abs(out[0] - exact).max() > bound)
+
+# autotuner: first run measures + persists, second run must be a pure
+# cache hit picking the same plan
+from flextree_tpu.planner.autotune import autotune_plan
+cache = os.path.join(tempfile.mkdtemp(), "plans.json")
+t1 = autotune_plan(8, 1 << 16, top_k=2, repeat=2, codecs=("f32", "int8"),
+                   cache_path=cache)
+t2 = autotune_plan(8, 1 << 16, top_k=2, repeat=2, codecs=("f32", "int8"),
+                   cache_path=cache)
+hit = int(t1.source == "measured" and t2.source == "cache"
+          and (t1.widths, t1.codec) == (t2.widths, t2.codec))
+print("QUANT_JSON: " + json.dumps(
+    {{"quant_error_bound_violations": violations, "autotune_cache_hit": hit}}))
+'''
+
+
+def run_quantize_tripwire(timeout_s: int = 240) -> dict:
+    """Supplementary keys ``quant_error_bound_violations`` (compressed
+    allreduce error vs the documented codec bound on this exact tree; 0 =
+    inside) and ``autotune_cache_hit`` (first autotune run measures and
+    persists, second is a pure cache hit; 1 = yes).  Subprocess-guarded
+    like the other tripwires: absent keys read as "not verified", never
+    as "clean"."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _QUANT_TRIPWIRE_CODE.format(repo=REPO)],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in p.stdout.splitlines():
+            if line.startswith("QUANT_JSON: "):
+                return json.loads(line[len("QUANT_JSON: "):])
+        return {
+            "quant_error": f"no QUANT_JSON (rc={p.returncode}); "
+            f"stderr tail: {p.stderr[-200:]}"
+        }
+    except (subprocess.SubprocessError, OSError, ValueError) as e:
+        return {"quant_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -489,6 +558,7 @@ def main() -> int:
     if result.get("metric") != "bench_error":
         result.update(run_static_analysis_tripwire())
         result.update(run_runtime_report_tripwire())
+        result.update(run_quantize_tripwire())
     print(json.dumps(result))
     return 0
 

@@ -75,6 +75,11 @@ class HloBudget:
     collective_dtypes: tuple[str, ...] | None = None
     #: require at least one donated input to survive lowering
     require_donation: bool = False
+    #: compressed entrypoints: at least one collective must carry this
+    #: element type on the wire (e.g. "i8") — a refactor that decodes
+    #: before the collective keeps the numerics quantized but silently
+    #: multiplies the wire bytes back up (violation kind "codec-upcast")
+    require_wire_dtype: str | None = None
     note: str = ""
 
 
@@ -145,6 +150,20 @@ def lint_ir(name: str, ir: str, budget: HloBudget) -> list[Violation]:
                         )
                     )
                     break
+    if budget.require_wire_dtype is not None:
+        seen = {dt for dts in collective_operand_dtypes(ir).values() for dt in dts}
+        if budget.require_wire_dtype not in seen:
+            out.append(
+                Violation(
+                    "hlo",
+                    "codec-upcast",
+                    name,
+                    f"no collective carries {budget.require_wire_dtype} on "
+                    f"the wire (saw {sorted(seen)}): the codec was decoded "
+                    f"before the collective — numerics stay quantized while "
+                    f"the wire bytes silently multiply back up",
+                )
+            )
     if budget.require_donation and "jax.buffer_donor" not in ir:
         out.append(
             Violation(
@@ -190,6 +209,35 @@ def _lower_allreduce(topo, op="sum", dtype=None, chunks=1, donate=False) -> str:
     fn = jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"))
     jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
     return jitted.lower(jnp.zeros((8, 64), dtype)).as_text()
+
+
+def _lower_compressed_allreduce(topo, codec, size: int = 2048, upcast: bool = False) -> str:
+    """Lower ``compressed_allreduce`` with ``codec`` over an 8-device mesh.
+
+    ``upcast=True`` builds the *corrupted* variant for the mutation
+    self-test: quantize/dequantize locally, then run the plain f32
+    collective — the classic silent wire upcast (numerically almost
+    indistinguishable from the compressed path, 4x the wire bytes).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.quantize import get_codec
+    from ..parallel import tree_allreduce
+    from ..parallel.compressed import compressed_allreduce
+    from ..parallel.mesh import flat_mesh
+
+    mesh = flat_mesh(8, "ft")
+
+    def f(row):
+        if upcast:
+            c = get_codec(codec)
+            return tree_allreduce(c.roundtrip(row[0], 0), "ft", topo)[None]
+        return compressed_allreduce(row[0], "ft", topo=topo, codec=codec, step=0)[None]
+
+    fn = jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"))
+    return jax.jit(fn).lower(jnp.zeros((8, size), jnp.float32)).as_text()
 
 
 def _lower_ring(dtype=None) -> str:
@@ -395,6 +443,31 @@ def lower_entrypoints(full: bool = True) -> list[tuple[str, str, HloBudget]]:
             ),
         ),
         (
+            "compressed_allreduce_bf16_4x2",
+            _lower_compressed_allreduce((4, 2), "bf16"),
+            HloBudget(
+                reduce_scatter=2, all_gather=2, all_reduce=0,
+                collective_permute=0,
+                collective_dtypes=("bf16",),
+                require_wire_dtype="bf16",
+                note="bf16 codec: the scheduled collectives must carry "
+                     "bf16 on the wire, never a silent f32 upcast",
+            ),
+        ),
+        (
+            "compressed_allreduce_int8_4x2",
+            _lower_compressed_allreduce((4, 2), "int8"),
+            HloBudget(
+                reduce_scatter=0, all_gather=4, all_reduce=0,
+                collective_permute=0, all_to_all=4,
+                collective_dtypes=("i8", "f32"),
+                require_wire_dtype="i8",
+                note="int8 codec: per-stage grouped all_to_all of (i8 "
+                     "payload, f32 scales) + encoded-forwarding gathers; "
+                     "the bulk payload must be i8 on the wire",
+            ),
+        ),
+        (
             "tree_allreduce_donated",
             _lower_allreduce((4, 2), donate=True),
             HloBudget(
@@ -488,6 +561,23 @@ def lower_leaf_unrolled_train_step() -> tuple[str, HloBudget]:
         all_reduce=native["all_reduce"] + expected_sync,
         exact=False,
         note=f"bucketed budget applied to a per-leaf ({n_synced}-leaf) sync",
+    )
+    return ir, budget
+
+
+def lower_codec_upcast_allreduce() -> tuple[str, HloBudget]:
+    """The 'codec-upcast' corruption: an int8-codec entrypoint refactored
+    to decode *before* the collective — quantized numerics (so every
+    numeric test still passes), f32 on the wire (4x the bytes).  The
+    linter must flag the missing i8 wire dtype."""
+    _require_devices(8)
+    ir = _lower_compressed_allreduce((4, 2), "int8", upcast=True)
+    budget = HloBudget(
+        reduce_scatter=0, all_gather=4, all_reduce=0,
+        collective_permute=0, all_to_all=4,
+        collective_dtypes=("i8", "f32"),
+        require_wire_dtype="i8",
+        note="int8-codec budget applied to a decode-before-wire program",
     )
     return ir, budget
 

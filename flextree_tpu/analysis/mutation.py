@@ -21,6 +21,7 @@ crossed-order         1       ``deadlock`` (a real wait-for cycle)
 watchdog-removal      1       ``unbounded-wait`` (lost recv deadline)
 leaf-unrolled         2       ``budget``
 dtype-drift           2       ``dtype-drift``
+codec-upcast          2       ``codec-upcast``
 wall-clock            3       ``wall-clock``
 host-rng              3       ``rng``
 traced-branch         3       ``traced-branch``
@@ -153,6 +154,13 @@ def _mutate_dtype_drift():
     return lint_ir("mutated:dtype_drifted_allreduce", ir, budget)
 
 
+def _mutate_codec_upcast():
+    from .hlo_lint import lint_ir, lower_codec_upcast_allreduce
+
+    ir, budget = lower_codec_upcast_allreduce()
+    return lint_ir("mutated:codec_upcast_allreduce", ir, budget)
+
+
 # ----------------------------------------------------- layer 3 mutations
 
 _HYGIENE_MUTANT = '''
@@ -195,6 +203,7 @@ MUTATIONS = {
     "watchdog-removal": ("unbounded-wait", "schedule", _mutate_watchdog_removal),
     "leaf-unrolled": ("budget", "hlo", _mutate_leaf_unrolled),
     "dtype-drift": ("dtype-drift", "hlo", _mutate_dtype_drift),
+    "codec-upcast": ("codec-upcast", "hlo", _mutate_codec_upcast),
     "wall-clock": ("wall-clock", "jit", _mutate_hygiene("wall-clock")),
     "host-rng": ("rng", "jit", _mutate_hygiene("rng")),
     "traced-branch": ("traced-branch", "jit", _mutate_hygiene("traced-branch")),

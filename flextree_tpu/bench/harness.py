@@ -253,6 +253,11 @@ class GradSyncBenchConfig:
     repeat: int = 10
     chunks: int = 2  # the ours_chunked row's pipelining factor
     bucket_bytes: int | None = None  # None -> planner-derived
+    # extra wire-codec rows (ops/quantize.py), e.g. ("bf16", "int8"):
+    # each adds an ``ours_fused_<codec>`` row — excluded from the bitwise
+    # identity check (lossy by design) and checked against the codec's
+    # documented error bound instead
+    codecs: tuple = ()
 
 
 def run_grad_sync_bench(cfg: GradSyncBenchConfig) -> dict:
@@ -276,12 +281,12 @@ def run_grad_sync_bench(cfg: GradSyncBenchConfig) -> dict:
     dev_specs = {k: P() for k in tree}  # every leaf replicated -> synced
     io_specs = {k: P("dp") for k in tree}
 
-    def make_fn(bucket_bytes, chunks):
+    def make_fn(bucket_bytes, chunks, codec="f32"):
         def f(t):
             rows = {k: v[0] for k, v in t.items()}
             out = sync_grads(
                 rows, dev_specs, ("dp",), topos,
-                bucket_bytes=bucket_bytes, chunks=chunks,
+                bucket_bytes=bucket_bytes, chunks=chunks, codec=codec,
             )
             return {k: v[None] for k, v in out.items()}
 
@@ -297,6 +302,8 @@ def run_grad_sync_bench(cfg: GradSyncBenchConfig) -> dict:
         "ours_fused": make_fn(cfg.bucket_bytes, 1),
         "ours_chunked": make_fn(cfg.bucket_bytes, cfg.chunks),
     }
+    for codec in cfg.codecs:
+        variants[f"ours_fused_{codec}"] = make_fn(cfg.bucket_bytes, 1, codec)
     outs = {
         name: jax.block_until_ready(fn(tree))  # also warms the jit
         for name, fn in variants.items()
@@ -304,8 +311,11 @@ def run_grad_sync_bench(cfg: GradSyncBenchConfig) -> dict:
     rows = _interleaved_times(
         {name: (fn, (tree,)) for name, fn in variants.items()}, cfg.repeat
     )
-    for name in ("ours_fused", "ours_chunked"):
-        rows[name]["vs_per_leaf"] = rows["per_leaf"]["min_ms"] / rows[name]["min_ms"]
+    for name in rows:
+        if name != "per_leaf":
+            rows[name]["vs_per_leaf"] = (
+                rows["per_leaf"]["min_ms"] / rows[name]["min_ms"]
+            )
 
     identical = all(
         np.asarray(outs["per_leaf"][k]).tobytes()
@@ -315,6 +325,35 @@ def run_grad_sync_bench(cfg: GradSyncBenchConfig) -> dict:
     )
     if not identical:
         raise RuntimeError("fused sync output diverged from per-leaf (bitwise)")
+    if cfg.codecs:
+        # lossy rows: no bitwise contract — hold them to the codec's
+        # documented error bound against the exact per-leaf sync instead
+        from ..ops.quantize import get_codec
+        from ..schedule.stages import LonelyTopology
+
+        t = Topology.resolve(n, cfg.topo)
+        if isinstance(t, LonelyTopology):
+            widths, lonely = t.tree.widths, t.lonely
+        else:
+            widths, lonely = t.widths, 0
+        for codec in cfg.codecs:
+            c = get_codec(codec)
+            worst = 0.0
+            for k in tree:
+                exact = np.asarray(outs["per_leaf"][k], dtype=np.float64)
+                got = np.asarray(
+                    outs[f"ours_fused_{codec}"][k], dtype=np.float64
+                )
+                amax = float(np.abs(np.asarray(tree[k])).max())
+                bound = c.error_bound(amax, n, widths, lonely) + 1e-5
+                err = float(np.abs(got - exact).max())
+                worst = max(worst, err / bound if bound else 0.0)
+                if c.lossy and err > bound:
+                    raise RuntimeError(
+                        f"codec {codec} sync error {err:.5f} exceeds the "
+                        f"documented bound {bound:.5f} on leaf {k}"
+                    )
+            rows[f"ours_fused_{codec}"]["err_over_bound"] = worst
     buckets = plan_buckets(
         [v[0] for v in tree.values()], [P()] * cfg.n_leaves, ("dp",),
         topos=topos, axis_sizes={"dp": n}, bucket_bytes=cfg.bucket_bytes,

@@ -1,5 +1,7 @@
-"""Reduction ops: the dtype/op support matrix and kernels."""
+"""Reduction ops: the dtype/op support matrix and kernels, plus the wire
+codecs for compressed collectives."""
 
+from .quantize import CODECS, Codec, decode_int8, encode_int8, get_codec
 from .reduce import ReduceOp, SUPPORTED_OPS, check_dtype, get_op
 
 __all__ = [
@@ -7,6 +9,11 @@ __all__ = [
     "SUPPORTED_OPS",
     "check_dtype",
     "get_op",
+    "Codec",
+    "CODECS",
+    "get_codec",
+    "encode_int8",
+    "decode_int8",
     "reduce_stacked",
     "reduce_stacked_reference",
     "flash_attention",

@@ -1,0 +1,217 @@
+"""Wire codecs for compressed collectives: what the bytes on the fabric are.
+
+The reference (and our port, until this module) varies only the tree
+*shape*; the payload dtype is whatever the gradient is.  EQuARX
+(PAPERS.md, arXiv:2506.17615) shows that quantizing the allreduce payload
+inside the collective recovers large wall-clock wins at equal model
+quality — the bytes on the wire become a *chosen* quantity, exactly like
+the stage widths.  This module defines the codecs; the per-hop application
+inside the tree/ring schedules lives in ``parallel/compressed.py``.
+
+Codecs:
+
+- ``f32`` — identity.  ``compressed_allreduce`` routes straight to the
+  uncompressed ``allreduce``; bitwise-identical by construction (and by
+  property test + compiled-HLO guard in ``tests/test_quantize.py``).
+- ``bf16`` — payload cast to bfloat16; the scheduled collectives carry
+  (and accumulate in) bf16 on the wire.  Ratio 0.5.
+- ``int8`` — block-scaled 8-bit quantization: each ``block_size`` run of
+  elements shares one f32 scale ``amax/127``; values are quantized with
+  **deterministic stochastic rounding** keyed off the training step
+  counter (an integer hash of (element index, step, salt) — no RNG keys,
+  no host entropy, nothing the jit-hygiene layer would flag; the same
+  step re-traces to the same bits).  Wire payload is int8 plus one f32
+  scale per block: ratio ``0.25 + 4/(4*block_size)``.
+
+Stochastic rounding is what makes the quantizer *unbiased*
+(``E[decode(encode(x))] = x``), which error feedback turns into exact
+long-run gradients (see ``docs/QUANTIZED_COLLECTIVES.md``); keying it off
+the step counter keeps the trace pure — the reference point is EF21/EF14
+style error feedback, carried in the train state by ``parallel/train.py``.
+
+Error bound (the documented contract the bench driver machine-checks):
+one encode of a buffer whose partial sums are bounded by ``A`` has
+per-element error ``<= A / 127`` (stochastic rounding error is strictly
+less than one quantization step).  A full allreduce over ``n`` ranks
+quantizes partial sums bounded by ``n * amax`` once per hop on the
+accumulation path, so
+
+    |result - exact| <= hops * n * amax / 127        (int8)
+    |result - exact| <= hops * n * amax * 2**-8      (bf16)
+
+with ``hops = num_stages + 1`` for a tree (each phase-1 stage re-encodes
+the partial sums; phase 2 encodes the final tile once and forwards it
+still-encoded) and ``hops = n`` for the ring — see
+:meth:`Codec.error_bound`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "Codec",
+    "CODECS",
+    "get_codec",
+    "encode_int8",
+    "decode_int8",
+    "DEFAULT_BLOCK",
+]
+
+#: Elements sharing one int8 scale.  1024 keeps the scale overhead at
+#: ~0.4% of payload while the per-block amax stays tight enough that the
+#: documented bound is far from the f32 noise floor.
+DEFAULT_BLOCK = 1024
+
+
+def _uniform01(n: int, step, salt: int):
+    """Deterministic per-element uniforms in [0, 1): an integer bit-mix of
+    (element index, step, salt).  ``step`` may be a traced int scalar (the
+    train-state step counter) — everything here is pure jnp, so the same
+    (shape, step, salt) re-traces to the same bits on any backend, and
+    there is no RNG key threading and no host entropy in the trace."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    i = lax.iota(jnp.uint32, n)
+    s = jnp.asarray(step, jnp.int32).astype(jnp.uint32)
+    k = i * np.uint32(0x9E3779B9)
+    k = k ^ (s * np.uint32(0x85EBCA6B) + np.uint32((salt * 0xC2B2AE35) & 0xFFFFFFFF))
+    # xorshift-multiply finalizer (splitmix-style avalanche)
+    k = k ^ (k >> 15)
+    k = k * np.uint32(0x2C1B3C6D)
+    k = k ^ (k >> 12)
+    k = k * np.uint32(0x297A2D39)
+    k = k ^ (k >> 15)
+    return (k >> 8).astype(jnp.float32) * np.float32(2.0**-24)
+
+
+def _pad_to_block(v, block: int):
+    import jax.numpy as jnp
+
+    pad = (-v.shape[-1]) % block
+    if pad:
+        width = [(0, 0)] * (v.ndim - 1) + [(0, pad)]
+        v = jnp.pad(v, width)
+    return v
+
+
+def encode_int8(v, step=0, *, salt: int = 0, block: int = DEFAULT_BLOCK):
+    """Block-scaled int8 encode of ``v`` (..., L) along the last axis.
+
+    Returns ``(q, scales)``: ``q`` int8 of shape (..., ceil(L/B)*B) and
+    ``scales`` f32 of shape (..., ceil(L/B)).  The trailing pad (zeros)
+    quantizes to 0 exactly, so decode+slice is lossless about the pad.
+    Stochastic rounding: ``q = floor(x/scale + u)`` with ``u`` from
+    :func:`_uniform01` — unbiased, deterministic in (step, salt).
+    """
+    import jax.numpy as jnp
+
+    v = _pad_to_block(v, block)
+    b = v.reshape(v.shape[:-1] + (-1, block))
+    amax = jnp.max(jnp.abs(b), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    u = _uniform01(int(np.prod(b.shape)), step, salt).reshape(b.shape)
+    q = jnp.floor(b / scale[..., None] + u)
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q.reshape(v.shape), scale
+
+
+def decode_int8(q, scales, length: int | None = None, *, block: int = DEFAULT_BLOCK):
+    """Inverse of :func:`encode_int8`; ``length`` slices the block pad off
+    the last axis (None keeps the padded length)."""
+    import jax.numpy as jnp
+
+    b = q.reshape(q.shape[:-1] + (-1, block)).astype(jnp.float32)
+    out = (b * scales[..., None]).reshape(q.shape)
+    if length is not None and length != out.shape[-1]:
+        out = out[..., :length]
+    return out
+
+
+@dataclass(frozen=True)
+class Codec:
+    """One wire format for the compressed collectives.
+
+    ``wire_ratio`` is payload wire bytes per f32 input byte (scales
+    included for int8) — the factor the cost model multiplies the
+    bandwidth term by.  ``hop_cost`` marks codecs that pay a per-hop
+    encode/decode pass (priced by ``TpuCostParams.codec_bw_GBps``).
+    """
+
+    name: str
+    wire_ratio: float
+    lossy: bool
+    hop_cost: bool  # per-hop encode/decode work on the accumulation path
+    block: int = DEFAULT_BLOCK
+
+    def roundtrip(self, x, step=0, *, salt: int = 0):
+        """The canonical *local* lossy map ``C(x)`` — decode(encode(x)) on
+        the flat buffer.  This is the residual reference for error
+        feedback: ``e' = v - C(v)`` (``parallel/train.py``).  For tree
+        schedules whose stage-0 tiles are block-aligned, the wire's first
+        encode is literally this map (``parallel/compressed.py`` reuses
+        salt 0 for the input encode), so the EF telescoping is exact."""
+        import jax.numpy as jnp
+
+        if not self.lossy:
+            return x
+        if self.name == "bf16":
+            return x.astype(jnp.bfloat16).astype(x.dtype)
+        shape = x.shape
+        v = x.reshape(-1).astype(jnp.float32)
+        q, s = encode_int8(v, step, salt=salt, block=self.block)
+        return decode_int8(q, s, v.shape[0], block=self.block).reshape(shape).astype(x.dtype)
+
+    def hops_for(self, n: int, widths, lonely: int = 0) -> int:
+        """Encode events on the accumulation path of one allreduce: each
+        phase-1 stage re-encodes partial sums, phase 2 encodes once and
+        forwards; the ring re-encodes per fold step; lonely shapes pay the
+        buddy fold/restore encodes plus per-stage encodes both phases
+        (their prefix-tree stages ride ppermute rings that cannot forward
+        encoded data across stage boundaries)."""
+        if widths is not None and tuple(widths) == (1,):
+            return max(n, 1)  # (n-1) fold hops + 1 phase-2 encode
+        k = len(tuple(widths)) if widths is not None else 1
+        if lonely:
+            return 2 * k + 2  # buddy fold + k RS + k AG + restore
+        return k + 1
+
+    def error_bound(self, amax: float, n: int, widths=None, lonely: int = 0) -> float:
+        """Documented per-element absolute error bound of one allreduce of
+        data with per-rank max |x| <= ``amax`` over ``n`` ranks (see the
+        module docstring for the derivation).  0 for the identity codec."""
+        if not self.lossy:
+            return 0.0
+        hops = self.hops_for(n, widths, lonely)
+        step_size = 1.0 / 127.0 if self.name == "int8" else 2.0**-8
+        return hops * n * float(amax) * step_size
+
+
+CODECS: dict[str, Codec] = {
+    "f32": Codec("f32", wire_ratio=1.0, lossy=False, hop_cost=False),
+    "bf16": Codec("bf16", wire_ratio=0.5, lossy=True, hop_cost=False),
+    "int8": Codec(
+        "int8",
+        wire_ratio=0.25 + 4.0 / (4.0 * DEFAULT_BLOCK),
+        lossy=True,
+        hop_cost=True,
+    ),
+}
+
+
+def get_codec(codec) -> Codec:
+    """Resolve a codec name (or pass through a Codec).  Unknown names
+    raise, mirroring ``ops.reduce.get_op``."""
+    if isinstance(codec, Codec):
+        return codec
+    if codec is None:
+        return CODECS["f32"]
+    try:
+        return CODECS[codec]
+    except KeyError:
+        raise ValueError(
+            f"unsupported codec {codec!r}; supported: {sorted(CODECS)}"
+        ) from None

@@ -33,6 +33,7 @@ from .bucketing import (
     replication_key,
     spec_axes,
 )
+from .compressed import compressed_allreduce, local_residual
 from .mesh import allreduce_over_mesh, flat_mesh, topology_from_mesh
 from .ring_attention import attention_reference, local_attention, ring_attention
 from .ulysses import heads_to_seq, seq_to_heads, ulysses_attention

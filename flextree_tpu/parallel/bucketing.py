@@ -134,6 +134,7 @@ def plan_buckets(
     bucket_bytes: int | None = None,
     params=None,
     max_bucket_bytes: int | None = None,
+    codec=None,
 ) -> tuple[Bucket, ...]:
     """Partition flattened gradient leaves into fused sync buckets.
 
@@ -168,7 +169,7 @@ def plan_buckets(
         if cap is None:
             cap = _derived_bucket_bytes(
                 sum(sizes), len(idxs), axes, topos or {}, axis_sizes or {},
-                params, max_bucket_bytes,
+                params, max_bucket_bytes, codec,
             )
         cap = max(int(cap), 1)
         cur: list[int] = []
@@ -185,11 +186,14 @@ def plan_buckets(
 
 
 def _derived_bucket_bytes(
-    total_bytes, n_leaves, axes, topos, axis_sizes, params, max_bucket_bytes
+    total_bytes, n_leaves, axes, topos, axis_sizes, params, max_bucket_bytes,
+    codec=None,
 ):
     """Planner-derived bucket size for one (axes, dtype) group: the sync
     runs one allreduce per axis per bucket, so the launch term the chooser
-    amortizes is the sum of the per-axis fixed costs."""
+    amortizes is the sum of the per-axis fixed costs.  ``codec`` makes the
+    chooser's byte terms wire-accurate for compressed syncs (fewer wire
+    bytes per bucket -> the argmin shifts toward fewer, larger buckets)."""
     from ..planner.choose import choose_bucket_bytes
 
     cost_topos = []
@@ -204,7 +208,7 @@ def _derived_bucket_bytes(
     if not cost_topos:
         return max_bucket_bytes
     derived = choose_bucket_bytes(
-        total_bytes, cost_topos, n_leaves=n_leaves, params=params
+        total_bytes, cost_topos, n_leaves=n_leaves, params=params, codec=codec
     )
     return min(derived, max_bucket_bytes)
 
@@ -295,6 +299,53 @@ def _fused_axis_allreduce(leaves, axis_name, topo, chunks: int = 1):
     return out
 
 
+def _unpack_to(leaves, fused):
+    """Reshape a fused flat f32 buffer back into the leaves' shapes/dtypes."""
+    return [
+        p.reshape(g.shape).astype(g.dtype)
+        for p, g in zip(_unpack(fused, [g.size for g in leaves]), leaves)
+    ]
+
+
+def _fused_compressed_bucket(leaves, axes, topos, codec, chunks, step, bi, nbytes):
+    """Lossy-codec bucket sync: pack the leaves into one flat buffer, run
+    one ``compressed_allreduce`` per axis, unpack.  No bitwise contract
+    (that belongs to the identity codec), so no block-interleaving or
+    split-tail choreography is needed — the compressed collective handles
+    its own sub-N tail in exact f32.  Returns (synced leaves, per-leaf
+    input-quantization residuals): wire-exact when the FIRST axis is
+    compressed (only that axis sees this rank's local data — a residual
+    taken after an exact psum axis would be re-injected once per rank of
+    that axis next step), else the canonical ``x - C(x)``.  Same rule as
+    the per-leaf path in ``train.sync_grads``."""
+    from .compressed import compressed_allreduce, local_residual
+
+    flats = [g.reshape(-1).astype(jnp.float32) for g in leaves]
+    fused = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+    res = None
+    for k, ax in enumerate(axes):
+        name = f"ftq_bucket{bi}_{ax}_{len(leaves)}leaves_{nbytes}B"
+        with comm_span(name):
+            if topos[ax] is None:
+                fused = _NATIVE_PSUM(fused, ax)  # sentinel stays exact f32
+            elif res is None and k == 0:
+                fused, res = compressed_allreduce(
+                    fused, ax, topo=topos[ax], codec=codec, chunks=chunks,
+                    step=step, return_residual=True,
+                )
+            else:
+                fused = compressed_allreduce(
+                    fused, ax, topo=topos[ax], codec=codec, chunks=chunks,
+                    step=step,
+                )
+    if res is None:
+        # first axis was exact (psum sentinel) or no axis at all: canonical
+        # residual of the packed input
+        src = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        res = local_residual(src, codec, step)
+    return _unpack_to(leaves, fused), _unpack_to(leaves, res)
+
+
 def bucketed_sync_grads(
     grads,
     pspecs,
@@ -303,6 +354,9 @@ def bucketed_sync_grads(
     bucket_bytes: int | None = None,
     chunks: int = 1,
     params=None,
+    codec="f32",
+    step=0,
+    return_residual: bool = False,
 ):
     """Bucketed/fused FlexTree gradient sync — the fused twin of
     ``train.sync_grads`` (collective-context function; call inside
@@ -316,24 +370,47 @@ def bucketed_sync_grads(
     ``chunks > 1`` runs each bucket's tree collectives chunk-pipelined.
     Per-bucket ``comm_span`` scopes (``ft_bucket*``) mark each bucket's
     collectives in profiler traces so comm time is attributable per bucket.
+
+    A lossy ``codec`` routes each bucket through ``compressed_allreduce``
+    (wire-compressed per hop; the bitwise contract applies to the identity
+    codec only); ``return_residual=True`` then also returns the per-leaf
+    error-feedback residuals.
     """
+    from ..ops.quantize import get_codec
+
+    codec = get_codec(codec)
     flat_g, treedef = jax.tree.flatten(grads)
     flat_s = treedef.flatten_up_to(pspecs)
     axis_sizes = {ax: lax.axis_size(ax) for ax in mesh_axes}
     buckets = plan_buckets(
         flat_g, flat_s, mesh_axes, topos=topos, axis_sizes=axis_sizes,
         bucket_bytes=bucket_bytes, params=params,
+        codec=codec if codec.lossy else None,
     )
     out = list(flat_g)
+    residuals = [jnp.zeros_like(g) for g in flat_g] if return_residual else None
     for bi, b in enumerate(buckets):
         leaves = [out[i] for i in b.indices]
-        for ax in b.axes:
-            name = f"ft_bucket{bi}_{ax}_{len(b.indices)}leaves_{b.nbytes}B"
-            with comm_span(name):
-                if topos[ax] is None:
-                    leaves = _fused_native_psum(leaves, ax)
-                else:
-                    leaves = _fused_axis_allreduce(leaves, ax, topos[ax], chunks)
+        if codec.lossy:
+            leaves, res = _fused_compressed_bucket(
+                leaves, b.axes, topos, codec, chunks, step, bi, b.nbytes
+            )
+            if return_residual:
+                for i, r in zip(b.indices, res):
+                    residuals[i] = r
+        else:
+            for ax in b.axes:
+                name = f"ft_bucket{bi}_{ax}_{len(b.indices)}leaves_{b.nbytes}B"
+                with comm_span(name):
+                    if topos[ax] is None:
+                        leaves = _fused_native_psum(leaves, ax)
+                    else:
+                        leaves = _fused_axis_allreduce(
+                            leaves, ax, topos[ax], chunks
+                        )
         for i, g in zip(b.indices, leaves):
             out[i] = g
-    return treedef.unflatten(out)
+    out_tree = treedef.unflatten(out)
+    if return_residual:
+        return out_tree, treedef.unflatten(residuals)
+    return out_tree

@@ -1,0 +1,391 @@
+"""Compression-aware FlexTree collectives: codecs applied per hop on the wire.
+
+``allreduce`` (``parallel/allreduce.py``) chooses the *shape* of the
+collective; this module additionally chooses the *bytes*: each hop of the
+tree/ring reduce-scatter + allgather carries the payload in a wire codec
+(``ops/quantize.py``) instead of the gradient dtype.  The shape of the
+implementation mirrors the uncompressed schedules exactly:
+
+- **tree phase 1** (per stage): the local buffer is split into the stage's
+  ``w`` tiles, each tile block-scale **encoded**, the encoded tiles (plus
+  their f32 scales, ~0.4% of the payload) exchanged by a *grouped*
+  ``lax.all_to_all`` over the stage groups — the same group/gap math as
+  ``psum_scatter(axis_index_groups=...)``, and the same tile ownership
+  (group position ``p`` ends up owning reduced tile ``p``) — then decoded
+  and folded in f32.  Partial sums are re-encoded at each subsequent
+  stage: compression is per hop, exactly like the wire formats EQuARX
+  fuses into XLA's collectives (PAPERS.md).
+- **tree phase 2**: the final reduced tile is encoded ONCE and forwarded
+  *still encoded* through the stage allgathers (pure data movement — no
+  decode/re-encode per hop), decoded once at the end.  One lossy event
+  for the whole phase, and the gathers move 1/4 the bytes.
+- **ring**: the classic 2(N-1)-step walk with the sent block encoded per
+  hop and folded in f32; phase 2 forwards encoded blocks.
+- **lonely**: the buddy fold/restore ``ppermute``s carry encoded payload,
+  and the prefix-tree stages run a compressed ppermute-ring (grouped
+  collectives cannot cover a partial axis — same constraint as
+  ``_grouped_reduce_scatter_generic``).
+
+The identity codec routes to the uncompressed ``allreduce`` — bitwise
+identical by construction; ``bf16`` rides the existing schedules with a
+bf16 payload (the collectives carry and accumulate bf16 on the wire — the
+HLO linter holds them to it).  Sum-only: wire compression of a gradient
+sync has no business reducing anything else.
+
+Error feedback: ``return_residual=True`` additionally returns
+``x - decode(encode(x))`` computed from the *actual* first-hop encode (the
+same blocks, salt and stochastic-rounding step the wire used), so the
+train state's EF residual telescopes exactly for tree schedules — see
+``docs/QUANTIZED_COLLECTIVES.md`` for the contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.quantize import Codec, decode_int8, encode_int8, get_codec
+from ..schedule.stages import LonelyTopology, Topology
+from .allreduce import (
+    _NATIVE_PSUM,
+    _groups_or_none,
+    _next_in_group,
+    _split_main_tail,
+    allreduce,
+)
+
+__all__ = ["compressed_allreduce", "local_residual"]
+
+# salt namespaces so no two encode sites share a stochastic-rounding
+# stream: phase-1 stage i uses salt i (stage 0 == the canonical salt 0 of
+# Codec.roundtrip), the others get distinct high bits
+_SALT_AG = 0x41470000
+_SALT_RING = 0x52490000
+_SALT_LONELY = 0x4C4F0000
+
+
+def _padded(tile: int, block: int) -> int:
+    return tile + (-tile) % block
+
+
+def compressed_allreduce(
+    x: jax.Array,
+    axis_name,
+    topo=None,
+    codec="f32",
+    chunks: int = 1,
+    step=0,
+    return_residual: bool = False,
+):
+    """Sum-allreduce of ``x`` over ``axis_name`` with ``codec`` on the wire.
+
+    Drop-in for ``allreduce(x, axis_name, topo, op='sum', chunks=...)``;
+    ``step`` keys the deterministic stochastic rounding (pass the train
+    step counter — traced scalars are fine).  ``return_residual=True``
+    returns ``(result, residual)`` where ``residual = x - C(x)`` is the
+    local input-quantization loss for error feedback (zeros for lossless
+    codecs; sub-N tails are reduced in exact f32, so their residual is 0).
+    """
+    codec = get_codec(codec)
+    n = lax.axis_size(axis_name)
+    if not codec.lossy or n <= 1:
+        out = allreduce(x, axis_name, topo=topo, op="sum", chunks=chunks)
+        if return_residual:
+            return out, jnp.zeros_like(x)
+        return out
+    if codec.name == "bf16":
+        wire = x.astype(jnp.bfloat16)
+        out = allreduce(wire, axis_name, topo=topo, op="sum", chunks=chunks)
+        out = out.astype(x.dtype)
+        if return_residual:
+            return out, x - wire.astype(x.dtype)
+        return out
+
+    # ---- int8 block-scaled, per-hop ----
+    topo = Topology.resolve(n, topo)
+    shape = x.shape
+    v = x.reshape(-1).astype(jnp.float32)
+    parts: list[jax.Array] = []
+    res_parts: list[jax.Array] = []
+    if isinstance(topo, LonelyTopology):
+        head, tail = _split_main_tail(v, topo.tree.num_nodes)
+        if head is not None:
+            out, res = _lonely_int8(head, axis_name, topo, codec, step)
+            parts.append(out)
+            res_parts.append(res)
+    else:
+        head, tail = _split_main_tail(v, n)
+        if head is not None:
+            if topo.is_ring:
+                out, res = _ring_int8(head, axis_name, n, codec, step)
+                parts.append(out)
+                res_parts.append(res)
+            else:
+                out, res = _tree_int8(head, axis_name, topo, codec, chunks, step)
+                parts.append(out)
+                res_parts.append(res)
+    if tail is not None:
+        # <N-element remainder: one tiny dense f32 collective, exact —
+        # compression has nothing to amortize on sub-N payloads
+        parts.append(_NATIVE_PSUM(tail, axis_name))
+        res_parts.append(jnp.zeros_like(tail))
+    out = (parts[0] if len(parts) == 1 else jnp.concatenate(parts)).reshape(shape)
+    out = out.astype(x.dtype)
+    if return_residual:
+        res = (
+            res_parts[0] if len(res_parts) == 1 else jnp.concatenate(res_parts)
+        ).reshape(shape)
+        return out, res.astype(x.dtype)
+    return out
+
+
+def local_residual(x: jax.Array, codec, step=0) -> jax.Array:
+    """Canonical local residual ``x - C(x)`` for error feedback when the
+    wire residual is not available (the ``codec.roundtrip`` map over the
+    flat buffer, salt 0 — exactly the stage-0 encode of a block-aligned
+    tree).  Zeros for lossless codecs."""
+    codec = get_codec(codec)
+    if not codec.lossy:
+        return jnp.zeros_like(x)
+    return x - codec.roundtrip(x, step)
+
+
+# --------------------------------------------------------------- tree
+
+
+def _stage_rs_int8(v, axis_name, topo: Topology, stage: int, codec: Codec, step):
+    """One compressed phase-1 stage: encode the w tiles, grouped
+    all_to_all of (int8 payload, f32 scales), decode + fold in f32.
+    Returns (reduced tile, this rank's decoded own-encode) — the latter is
+    the wire-exact roundtrip used for the stage-0 residual."""
+    w = topo.widths[stage]
+    tile = v.shape[0] // w
+    groups = _groups_or_none(topo, stage)
+    q, s = encode_int8(v.reshape(w, tile), step, salt=stage, block=codec.block)
+    with jax.named_scope(f"ftq_rs_stage{stage}_w{w}"):
+        qx = lax.all_to_all(
+            q, axis_name, split_axis=0, concat_axis=0, axis_index_groups=groups
+        )
+        sx = lax.all_to_all(
+            s, axis_name, split_axis=0, concat_axis=0, axis_index_groups=groups
+        )
+    dec = decode_int8(qx, sx, tile, block=codec.block)
+    own = decode_int8(q, s, tile, block=codec.block).reshape(-1)
+    return dec.sum(axis=0), own
+
+
+def _ag_int8(tile_v, axis_name, topo: Topology, codec: Codec, step, salt):
+    """Phase 2: encode the reduced tile once, forward it *encoded* through
+    the stage allgathers, decode every segment at the end."""
+    t = tile_v.shape[0]
+    tp = _padded(t, codec.block)
+    q, s = encode_int8(tile_v, step, salt=salt, block=codec.block)
+    for i in reversed(range(topo.num_stages)):
+        groups = _groups_or_none(topo, i)
+        with jax.named_scope(f"ftq_ag_stage{i}_w{topo.widths[i]}"):
+            q = lax.all_gather(q, axis_name, axis_index_groups=groups, axis=0, tiled=True)
+            s = lax.all_gather(s, axis_name, axis_index_groups=groups, axis=0, tiled=True)
+    segs = q.shape[0] // tp
+    dec = decode_int8(
+        q.reshape(segs, tp), s.reshape(segs, -1), t, block=codec.block
+    )
+    return dec.reshape(-1)
+
+
+def _tree_int8(head, axis_name, topo: Topology, codec: Codec, chunks: int, step):
+    """Compressed k-ary tree on the divisible head, optionally
+    chunk-pipelined with the same phase-2/phase-1 interleaving as
+    ``tree_allreduce``."""
+    from .allreduce import _chunk_sizes
+
+    n = topo.num_nodes
+
+    def rs_all(piece):
+        own0 = None
+        v = piece
+        for i in range(topo.num_stages):
+            v, own = _stage_rs_int8(v, axis_name, topo, i, codec, step)
+            if i == 0:
+                own0 = own
+        return v, own0
+
+    sizes = _chunk_sizes(head.size, n, chunks)
+    if len(sizes) == 1:
+        tile, own0 = rs_all(head)
+        out = _ag_int8(tile, axis_name, topo, codec, step, _SALT_AG)
+        return out, head - own0
+    pieces, off = [], 0
+    for sz in sizes:
+        pieces.append(head[off : off + sz])
+        off += sz
+    outs, residuals, scattered = [], [], None
+    for c, piece in enumerate(pieces):
+        with jax.named_scope(f"ftq_chunk{c}_rs"):
+            cur, own0 = rs_all(piece)
+        residuals.append(piece - own0)
+        if scattered is not None:
+            with jax.named_scope(f"ftq_chunk{c - 1}_ag"):
+                outs.append(
+                    _ag_int8(scattered, axis_name, topo, codec, step, _SALT_AG + c - 1)
+                )
+        scattered = cur
+    with jax.named_scope(f"ftq_chunk{len(pieces) - 1}_ag"):
+        outs.append(
+            _ag_int8(
+                scattered, axis_name, topo, codec, step, _SALT_AG + len(pieces) - 1
+            )
+        )
+    return jnp.concatenate(outs), jnp.concatenate(residuals)
+
+
+# --------------------------------------------------------------- ring
+
+
+def _ring_int8(head, axis_name, n: int, codec: Codec, step):
+    """Compressed ring: per-hop encode of the sent block, f32 fold at the
+    receiver; phase 2 forwards blocks still encoded.  The residual is the
+    canonical local map (ring blocks are first encoded at differing fold
+    depths, so no single wire encode covers the whole local buffer — see
+    docs/QUANTIZED_COLLECTIVES.md)."""
+    split = head.shape[0] // n
+    sp = _padded(split, codec.block)
+    nb = sp // codec.block
+    idx = lax.axis_index(axis_name)
+    right = [(j, (j + 1) % n) for j in range(n)]
+    v = head
+
+    for hop in range(n - 1):
+        send_b = (idx - hop) % n
+        recv_b = (idx - hop - 1) % n
+        chunk = lax.dynamic_slice_in_dim(v, send_b * split, split, axis=0)
+        q, s = encode_int8(chunk, step, salt=_SALT_RING + hop, block=codec.block)
+        with jax.named_scope(f"ftq_ring_rs{hop}"):
+            q = lax.ppermute(q, axis_name, right)
+            s = lax.ppermute(s, axis_name, right)
+        got = decode_int8(q, s, split, block=codec.block)
+        cur = lax.dynamic_slice_in_dim(v, recv_b * split, split, axis=0)
+        v = lax.dynamic_update_slice_in_dim(v, cur + got, recv_b * split, axis=0)
+
+    # phase 2: encode the owned (fully-reduced) block once, forward encoded
+    own_b = (idx + 1) % n
+    own = lax.dynamic_slice_in_dim(v, own_b * split, split, axis=0)
+    q, s = encode_int8(own, step, salt=_SALT_RING - 1, block=codec.block)
+    qbuf = jnp.zeros((n * sp,), jnp.int8)
+    sbuf = jnp.zeros((n * nb,), jnp.float32)
+    qbuf = lax.dynamic_update_slice_in_dim(qbuf, q, own_b * sp, axis=0)
+    sbuf = lax.dynamic_update_slice_in_dim(sbuf, s, own_b * nb, axis=0)
+    for hop in range(n - 1):
+        send_b = (idx + 1 - hop) % n
+        recv_b = (idx - hop) % n
+        cq = lax.dynamic_slice_in_dim(qbuf, send_b * sp, sp, axis=0)
+        cs = lax.dynamic_slice_in_dim(sbuf, send_b * nb, nb, axis=0)
+        with jax.named_scope(f"ftq_ring_ag{hop}"):
+            cq = lax.ppermute(cq, axis_name, right)
+            cs = lax.ppermute(cs, axis_name, right)
+        qbuf = lax.dynamic_update_slice_in_dim(qbuf, cq, recv_b * sp, axis=0)
+        sbuf = lax.dynamic_update_slice_in_dim(sbuf, cs, recv_b * nb, axis=0)
+    dec = decode_int8(
+        qbuf.reshape(n, sp), sbuf.reshape(n, nb), split, block=codec.block
+    )
+    res = head - decode_int8(*encode_int8(head, step, salt=0, block=codec.block),
+                             head.shape[0], block=codec.block)
+    return dec.reshape(-1), res
+
+
+# --------------------------------------------------------------- lonely
+
+
+def _compressed_grouped_rs(v, axis_name, topo: Topology, stage: int, codec: Codec, step):
+    """Width-w grouped reduce-scatter as a compressed ppermute ring —
+    the lossy twin of ``_grouped_reduce_scatter_generic`` (grouped XLA
+    collectives cannot cover a partial axis, so lonely prefix trees ride
+    permutations; ranks outside ``topo.num_nodes`` receive zeros and are
+    overwritten by the caller)."""
+    w, gap = topo.widths[stage], topo.gaps[stage]
+    tile = v.shape[0] // w
+    idx = lax.axis_index(axis_name)
+    pos = (idx // gap) % w
+    perm = [(r, _next_in_group(r, w, gap)) for r in range(topo.num_nodes)]
+
+    cur_send = (pos - 1) % w
+    acc = v
+    for hop in range(w - 1):
+        chunk = lax.dynamic_slice_in_dim(acc, cur_send * tile, tile, axis=0)
+        q, s = encode_int8(
+            chunk, step, salt=_SALT_LONELY + 16 * stage + hop, block=codec.block
+        )
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        got = decode_int8(q, s, tile, block=codec.block)
+        recv_b = (cur_send - 1) % w
+        cur = lax.dynamic_slice_in_dim(acc, recv_b * tile, tile, axis=0)
+        acc = lax.dynamic_update_slice_in_dim(acc, cur + got, recv_b * tile, axis=0)
+        cur_send = recv_b
+    return lax.dynamic_slice_in_dim(acc, pos * tile, tile, axis=0)
+
+
+def _compressed_grouped_ag(v, axis_name, topo: Topology, stage: int, codec: Codec, step):
+    """Width-w grouped allgather forwarding encoded blocks around the
+    group ring (phase-2 twin of ``_compressed_grouped_rs``)."""
+    w, gap = topo.widths[stage], topo.gaps[stage]
+    t = v.shape[0]
+    tp = _padded(t, codec.block)
+    nb = tp // codec.block
+    idx = lax.axis_index(axis_name)
+    pos = (idx // gap) % w
+    perm = [(r, _next_in_group(r, w, gap)) for r in range(topo.num_nodes)]
+
+    q, s = encode_int8(v, step, salt=_SALT_LONELY + 4096 + stage, block=codec.block)
+    qbuf = jnp.zeros((w * tp,), jnp.int8)
+    sbuf = jnp.zeros((w * nb,), jnp.float32)
+    qbuf = lax.dynamic_update_slice_in_dim(qbuf, q, pos * tp, axis=0)
+    sbuf = lax.dynamic_update_slice_in_dim(sbuf, s, pos * nb, axis=0)
+    for hop in range(w - 1):
+        send_b = (pos - hop) % w
+        recv_b = (pos - hop - 1) % w
+        cq = lax.dynamic_slice_in_dim(qbuf, send_b * tp, tp, axis=0)
+        cs = lax.dynamic_slice_in_dim(sbuf, send_b * nb, nb, axis=0)
+        cq = lax.ppermute(cq, axis_name, perm)
+        cs = lax.ppermute(cs, axis_name, perm)
+        qbuf = lax.dynamic_update_slice_in_dim(qbuf, cq, recv_b * tp, axis=0)
+        sbuf = lax.dynamic_update_slice_in_dim(sbuf, cs, recv_b * nb, axis=0)
+    dec = decode_int8(qbuf.reshape(w, tp), sbuf.reshape(w, nb), t, block=codec.block)
+    return dec.reshape(-1)
+
+
+def _lonely_int8(head, axis_name, topo: LonelyTopology, codec: Codec, step):
+    """Compressed ``m+l`` shape: encoded buddy fold, compressed prefix-tree
+    stages, encoded restore (structure mirrors ``lonely_allreduce``)."""
+    tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
+    idx = lax.axis_index(axis_name)
+    t = head.shape[0]
+
+    with jax.named_scope("ftq_lonely_fold"):
+        q, s = encode_int8(head, step, salt=_SALT_LONELY - 1, block=codec.block)
+        qg = lax.ppermute(q, axis_name, [(m + i, i) for i in range(l)])
+        sg = lax.ppermute(s, axis_name, [(m + i, i) for i in range(l)])
+        got = decode_int8(qg, sg, t, block=codec.block)
+        v = jnp.where(idx < l, head + got, head)
+    for i in range(tree.num_stages):
+        with jax.named_scope(f"ftq_lonely_rs{i}"):
+            v = _compressed_grouped_rs(v, axis_name, tree, i, codec, step)
+    for i in reversed(range(tree.num_stages)):
+        with jax.named_scope(f"ftq_lonely_ag{i}"):
+            v = _compressed_grouped_ag(v, axis_name, tree, i, codec, step)
+    with jax.named_scope("ftq_lonely_restore"):
+        q, s = encode_int8(v, step, salt=_SALT_LONELY - 2, block=codec.block)
+        q2 = lax.ppermute(q, axis_name, [(i, m + i) for i in range(l)])
+        s2 = lax.ppermute(s, axis_name, [(i, m + i) for i in range(l)])
+        back = decode_int8(q2, s2, t, block=codec.block)
+        # every rank adopts decode(encode(result)): the encode is
+        # deterministic and all tree ranks hold identical ``v``, so the
+        # lonely ranks' shipped copy is bit-identical to what the tree
+        # ranks compute locally — without this, lonely ranks would hold a
+        # re-quantized result the tree ranks don't (replica drift)
+        own = decode_int8(q, s, t, block=codec.block)
+        out = jnp.where(idx >= m, back, own)
+    res = head - decode_int8(
+        *encode_int8(head, step, salt=0, block=codec.block), t, block=codec.block
+    )
+    return out, res

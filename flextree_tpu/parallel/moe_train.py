@@ -30,8 +30,9 @@ from .train import (
     metric_specs,
     make_state_specs,
     make_train_state,
+    maybe_autotune_grad_topo,
     resolve_axis_topos,
-    sync_grads,
+    sync_with_feedback,
     validate_tp,
 )
 
@@ -44,14 +45,15 @@ __all__ = [
 ]
 
 
-def init_moe_train_state(key, cfg: MoEConfig) -> dict:
-    return make_train_state(init_moe_params(key, cfg))
+def init_moe_train_state(key, cfg: MoEConfig, train_cfg=None) -> dict:
+    return make_train_state(init_moe_params(key, cfg), train_cfg)
 
 
 def moe_state_specs(
-    cfg: MoEConfig, tp_axis: str | None = "tp", ep_axis: str | None = "ep"
+    cfg: MoEConfig, tp_axis: str | None = "tp", ep_axis: str | None = "ep",
+    train_cfg=None,
 ) -> dict:
-    return make_state_specs(moe_param_specs(cfg, tp_axis, ep_axis))
+    return make_state_specs(moe_param_specs(cfg, tp_axis, ep_axis), train_cfg)
 
 
 def factor_devices_moe(n: int) -> tuple[int, int, int, int]:
@@ -92,8 +94,11 @@ def make_moe_train_step(
     if model_cfg.top_k > model_cfg.n_experts:
         raise ValueError("top_k cannot exceed n_experts")
     validate_tp(model_cfg, tp_size)
+    train_cfg = maybe_autotune_grad_topo(
+        mesh, model_cfg, train_cfg, axis_names, init_fn=init_moe_params
+    )
 
-    sspecs = moe_state_specs(model_cfg, tp, ep)
+    sspecs = moe_state_specs(model_cfg, tp, ep, train_cfg)
     data_spec = P((dp, ep), sp)
     mesh_axes = axis_names
     n_devices = 1
@@ -128,9 +133,8 @@ def make_moe_train_step(
         )
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads = sync_grads(
-            grads, sspecs["params"], mesh_axes, topos,
-            bucket_bytes=train_cfg.bucket_bytes, chunks=train_cfg.grad_chunks,
+        grads, new_ef = sync_with_feedback(
+            state, grads, sspecs["params"], mesh_axes, topos, train_cfg
         )
 
         global_ce = ce
@@ -146,6 +150,8 @@ def make_moe_train_step(
         }
         grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
         new_state = adamw_apply(state, grads, train_cfg)
+        if new_ef is not None:
+            new_state["ef"] = new_ef
         return new_state, metrics
 
     mspec = metric_specs(train_cfg, {"loss": P(), "aux": P(), "total": P()})

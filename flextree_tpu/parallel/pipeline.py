@@ -55,9 +55,10 @@ from .train import (
     make_mesh_nd,
     make_state_specs,
     make_train_state,
+    maybe_autotune_grad_topo,
     resolve_axis_topos,
     spread_factors,
-    sync_grads,
+    sync_with_feedback,
     validate_tp,
 )
 
@@ -109,14 +110,17 @@ def pipeline_param_specs(
     return {"embed": P(None, None), "ln_f": P(None), "layers": stacked}
 
 
-def init_pipeline_train_state(key, cfg: TransformerConfig) -> dict:
-    return make_train_state(stack_layer_params(init_params(key, cfg)))
+def init_pipeline_train_state(key, cfg: TransformerConfig, train_cfg=None) -> dict:
+    return make_train_state(stack_layer_params(init_params(key, cfg)), train_cfg)
 
 
 def pipeline_state_specs(
-    cfg: TransformerConfig, pp_axis: str | None = "pp", tp_axis: str | None = "tp"
+    cfg: TransformerConfig, pp_axis: str | None = "pp", tp_axis: str | None = "tp",
+    train_cfg=None,
 ) -> dict:
-    return make_state_specs(pipeline_param_specs(cfg, pp_axis, tp_axis))
+    return make_state_specs(
+        pipeline_param_specs(cfg, pp_axis, tp_axis), train_cfg
+    )
 
 
 # ------------------------------------------------------------- mesh helper
@@ -239,8 +243,12 @@ def make_pipeline_train_step(
             f"n_layers={model_cfg.n_layers} must be divisible by pp={pp_size}"
         )
     validate_tp(model_cfg, mesh.shape[tp])
+    train_cfg = maybe_autotune_grad_topo(
+        mesh, model_cfg, train_cfg, axis_names,
+        init_fn=lambda k, cfg: stack_layer_params(init_params(k, cfg)),
+    )
 
-    sspecs = pipeline_state_specs(model_cfg, pp, tp)
+    sspecs = pipeline_state_specs(model_cfg, pp, tp, train_cfg)
     data_spec = P(dp, sp)
     mesh_axes = axis_names
 
@@ -274,9 +282,8 @@ def make_pipeline_train_step(
         loss, grads = jax.value_and_grad(local_loss)(state["params"])
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads = sync_grads(
-            grads, sspecs["params"], mesh_axes, topos,
-            bucket_bytes=train_cfg.bucket_bytes, chunks=train_cfg.grad_chunks,
+        grads, new_ef = sync_with_feedback(
+            state, grads, sspecs["params"], mesh_axes, topos, train_cfg
         )
         global_loss = loss
         for ax in mesh_axes:
@@ -285,6 +292,8 @@ def make_pipeline_train_step(
         metrics = {"loss": global_loss}
         grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
         new_state = adamw_apply(state, grads, train_cfg)
+        if new_ef is not None:
+            new_state["ef"] = new_ef
         return new_state, metrics
 
     mspec = metric_specs(train_cfg, {"loss": P()})

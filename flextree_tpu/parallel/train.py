@@ -62,6 +62,8 @@ __all__ = [
     "factor_devices",
     "resolve_axis_topos",
     "sync_grads",
+    "sync_with_feedback",
+    "maybe_autotune_grad_topo",
     "adamw_apply",
     "schedule_lr",
     "global_grad_norm",
@@ -108,6 +110,20 @@ class TrainConfig:
     # into C chunks with phase-2/phase-1 interleaving (allreduce chunks=C);
     # bitwise-identical for the sum sync, 1 = off.
     grad_chunks: int = 1
+    # wire codec for the gradient sync (ops/quantize.py): "f32" (identity,
+    # the default — bitwise-identical to the historical sync), "bf16", or
+    # "int8" (block-scaled, deterministic stochastic rounding keyed off
+    # the step counter).  Lossy codecs carry an EF21-style error-feedback
+    # residual in the train state ("ef", zeros at init — see
+    # init_train_state / docs/QUANTIZED_COLLECTIVES.md), so the long-run
+    # synced gradient converges to exact.
+    codec: str = "f32"
+    # measured plan autotuner (planner/autotune.py): when True and
+    # grad_topo is None, the step builders resolve the sync topology per
+    # mesh axis by timing the analytic top-K candidates on the live
+    # backend (cached under FLEXTREE_PLAN_CACHE — the second build is a
+    # pure cache hit) instead of trusting the cost-model argmin.
+    autotune: bool = False
 
 
 def prime_factors(n: int) -> list[int]:
@@ -168,14 +184,29 @@ def make_mesh_3d(
     return make_mesh_nd(n_devices, shape, axis_names)
 
 
-def make_train_state(params) -> dict:
-    """Fresh AdamW state around a parameter pytree (any layout)."""
-    return {
+def make_train_state(params, train_cfg: "TrainConfig | None" = None) -> dict:
+    """Fresh AdamW state around a parameter pytree (any layout).
+
+    A lossy gradient-sync codec (``train_cfg.codec``) adds the
+    error-feedback residual tree ``"ef"`` (zeros, param-shaped): each step
+    syncs ``grad + ef`` and stores what the wire's input quantization lost
+    back into ``ef``, so no gradient mass is ever dropped — only delayed.
+    """
+    state = {
         "params": params,
         "mu": jax.tree.map(jnp.zeros_like, params),
         "nu": jax.tree.map(jnp.zeros_like, params),
         "step": jnp.zeros((), jnp.int32),
     }
+    if train_cfg is not None and _sync_codec(train_cfg).lossy:
+        state["ef"] = jax.tree.map(jnp.zeros_like, params)
+    return state
+
+
+def _sync_codec(train_cfg: "TrainConfig"):
+    from ..ops.quantize import get_codec
+
+    return get_codec(train_cfg.codec)
 
 
 def validate_tp(model_cfg: TransformerConfig, tp_size: int) -> None:
@@ -191,17 +222,27 @@ def validate_tp(model_cfg: TransformerConfig, tp_size: int) -> None:
         )
 
 
-def init_train_state(key, cfg: TransformerConfig) -> dict:
-    return make_train_state(init_params(key, cfg))
+def init_train_state(
+    key, cfg: TransformerConfig, train_cfg: "TrainConfig | None" = None
+) -> dict:
+    return make_train_state(init_params(key, cfg), train_cfg)
 
 
-def make_state_specs(pspecs) -> dict:
-    """Optimizer-state specs around parameter specs (moments shard alike)."""
-    return {"params": pspecs, "mu": pspecs, "nu": pspecs, "step": P()}
+def make_state_specs(pspecs, train_cfg: "TrainConfig | None" = None) -> dict:
+    """Optimizer-state specs around parameter specs (moments shard alike;
+    the error-feedback residual of a lossy sync codec shards alike too)."""
+    specs = {"params": pspecs, "mu": pspecs, "nu": pspecs, "step": P()}
+    if train_cfg is not None and _sync_codec(train_cfg).lossy:
+        specs["ef"] = pspecs
+    return specs
 
 
-def state_specs(cfg: TransformerConfig, tp_axis: str | None = "tp") -> dict:
-    return make_state_specs(param_specs(cfg, tp_axis))
+def state_specs(
+    cfg: TransformerConfig,
+    tp_axis: str | None = "tp",
+    train_cfg: "TrainConfig | None" = None,
+) -> dict:
+    return make_state_specs(param_specs(cfg, tp_axis), train_cfg)
 
 
 def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
@@ -232,6 +273,9 @@ def sync_grads(
     topos: dict,
     bucket_bytes: int | None = 0,
     chunks: int = 1,
+    codec="f32",
+    step=0,
+    return_residual: bool = False,
 ):
     """FlexTree gradient sync: sum each leaf over its replication axes.
 
@@ -248,25 +292,132 @@ def sync_grads(
     The train-step builders pass their ``TrainConfig.bucket_bytes`` through,
     so the bucketed path is the production default.  ``chunks > 1`` runs
     tree collectives chunk-pipelined (both paths).
-    """
-    from .allreduce import _NATIVE_PSUM
 
+    ``codec`` selects the wire format (``ops/quantize.py``): the identity
+    keeps both paths exactly as before (bitwise contract intact); a lossy
+    codec routes FlexTree axes through ``compressed_allreduce`` with
+    ``step`` keying the deterministic stochastic rounding.  ``"psum"``
+    sentinel axes stay native f32 — compression is a FlexTree property.
+    ``return_residual=True`` additionally returns the per-leaf input-
+    quantization residual for error feedback: the wire-exact residual of
+    the first compressed axis (the one that sees this rank's local data),
+    or the canonical ``x - C(x)`` when the first synced axis is exact.
+    """
+    from ..ops.quantize import get_codec
+    from .allreduce import _NATIVE_PSUM
+    from .compressed import compressed_allreduce, local_residual
+
+    codec = get_codec(codec)
     if bucket_bytes != 0:
         return bucketed_sync_grads(
             grads, pspecs, mesh_axes, topos,
             bucket_bytes=bucket_bytes, chunks=chunks,
+            codec=codec, step=step, return_residual=return_residual,
         )
 
     def sync(g, spec):
-        for ax in replication_key(spec, mesh_axes):
+        res = None
+        for k, ax in enumerate(replication_key(spec, mesh_axes)):
             topo = topos[ax]
             if topo is None:
                 g = _NATIVE_PSUM(g, ax)
-            else:
+            elif not codec.lossy:
                 g = allreduce(g, ax, topo=topo, op="sum", chunks=chunks)
-        return g
+            elif k == 0:
+                # only the FIRST axis sees this rank's local data, so only
+                # its wire residual has per-rank EF semantics: a residual
+                # taken after an exact psum axis would be replicated over
+                # that axis and re-injected once PER RANK next step,
+                # over-counting by the axis size.  Later-axis (and
+                # post-psum) losses fall back to the canonical residual —
+                # same rule as the bucketed path.
+                g, res = compressed_allreduce(
+                    g, ax, topo=topo, codec=codec, chunks=chunks, step=step,
+                    return_residual=True,
+                )
+            else:
+                g = compressed_allreduce(
+                    g, ax, topo=topo, codec=codec, chunks=chunks, step=step
+                )
+        return g, res
 
-    return jax.tree.map(sync, grads, pspecs, is_leaf=lambda x: x is None)
+    flat_g, treedef = jax.tree.flatten(grads)
+    flat_s = treedef.flatten_up_to(pspecs)
+    synced, residuals = [], []
+    for g, spec in zip(flat_g, flat_s):
+        out, res = sync(g, spec)
+        synced.append(out)
+        if return_residual:
+            residuals.append(
+                res if res is not None else local_residual(g, codec, step)
+            )
+    out_tree = treedef.unflatten(synced)
+    if return_residual:
+        return out_tree, treedef.unflatten(residuals)
+    return out_tree
+
+
+def sync_with_feedback(state, grads, pspecs, mesh_axes, topos, train_cfg):
+    """The train-step gradient sync under ``train_cfg``: identity codec ->
+    the plain (bitwise) sync and ``None``; lossy codec -> error-feedback
+    sync — add the carried residual, sync ``grad + ef`` compressed, return
+    the new residual (what the wire's input quantization lost) for the
+    caller to store back into ``state['ef']``.  Shared by the dense,
+    pipeline and MoE steps so their EF accounting cannot diverge."""
+    codec = _sync_codec(train_cfg)
+    if not codec.lossy:
+        return (
+            sync_grads(
+                grads, pspecs, mesh_axes, topos,
+                bucket_bytes=train_cfg.bucket_bytes,
+                chunks=train_cfg.grad_chunks,
+            ),
+            None,
+        )
+    v = jax.tree.map(lambda g, e: g + e.astype(g.dtype), grads, state["ef"])
+    return sync_grads(
+        v, pspecs, mesh_axes, topos,
+        bucket_bytes=train_cfg.bucket_bytes, chunks=train_cfg.grad_chunks,
+        codec=codec, step=state["step"], return_residual=True,
+    )
+
+
+def maybe_autotune_grad_topo(
+    mesh: Mesh, model_cfg, train_cfg: "TrainConfig", axis_names,
+    init_fn=None,
+) -> "TrainConfig":
+    """Resolve the gradient-sync topology by *measurement* when
+    ``train_cfg.autotune`` is set and no explicit ``grad_topo`` was given.
+
+    Host-level (runs once at step-build time, never inside the trace):
+    for each mesh axis with size > 1, time the analytic top-K candidates
+    for the model's total parameter bytes under the configured codec
+    (``planner.autotune.autotune_plan``) and pin the measured winner into
+    ``grad_topo``.  Results persist in the ``FLEXTREE_PLAN_CACHE`` plan
+    cache, so rebuilding the step (or re-running the trainer) is a pure
+    cache hit; axes with equal size share one cache entry by construction.
+    """
+    if not train_cfg.autotune or train_cfg.grad_topo is not None:
+        return train_cfg
+    from ..planner.autotune import autotune_plan
+
+    if init_fn is None:
+        init_fn = init_params  # dense; pipeline/MoE builders pass theirs
+    shapes = jax.eval_shape(
+        lambda k: init_fn(k, model_cfg), jax.random.PRNGKey(0)
+    )
+    nbytes = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(shapes))
+    spec: dict = {}
+    for ax in axis_names:
+        n = int(mesh.shape[ax])
+        if n <= 1:
+            continue
+        plan = autotune_plan(
+            n, nbytes, dtype="float32", codecs=(train_cfg.codec,), top_k=3,
+            repeat=3,
+        )
+        spec[ax] = plan.to_ft_topo()
+    return dataclasses.replace(train_cfg, grad_topo=spec, autotune=False)
 
 
 def schedule_lr(train_cfg: "TrainConfig", step):
@@ -400,8 +551,11 @@ def make_train_step(
         if a not in mesh.shape:
             raise ValueError(f"mesh is missing axis {a!r}; has {mesh.axis_names}")
     validate_tp(model_cfg, mesh.shape[tp])
+    train_cfg = maybe_autotune_grad_topo(
+        mesh, model_cfg, train_cfg, axis_names
+    )
 
-    sspecs = state_specs(model_cfg, tp)
+    sspecs = state_specs(model_cfg, tp, train_cfg)
     data_spec = P(dp, sp)
     mesh_axes = axis_names
 
@@ -423,15 +577,16 @@ def make_train_step(
         loss, grads = jax.value_and_grad(local_loss)(state["params"])
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads = sync_grads(
-            grads, sspecs["params"], mesh_axes, topos,
-            bucket_bytes=train_cfg.bucket_bytes, chunks=train_cfg.grad_chunks,
+        grads, new_ef = sync_with_feedback(
+            state, grads, sspecs["params"], mesh_axes, topos, train_cfg
         )
         global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
 
         metrics = {"loss": global_loss}
         grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
         new_state = adamw_apply(state, grads, train_cfg)
+        if new_ef is not None:
+            new_state["ef"] = new_ef
         return new_state, metrics
 
     mspec = metric_specs(train_cfg, {"loss": P()})

@@ -21,15 +21,24 @@ from .cost_model import (
     ring_cost,
 )
 from .calibrate import (
+    CALIBRATION_SCHEMA,
     MeasuredPoint,
+    backend_fingerprint,
     default_params,
     feature_vector,
     fit_cost_params,
     load_calibration,
     measure_points,
+    plan_cache_key,
     predict_us,
     save_calibration,
     spearman,
+)
+from .autotune import (
+    DEFAULT_CODECS,
+    TunedPlan,
+    analytic_shortlist,
+    autotune_plan,
 )
 from .choose import (
     Candidate,
@@ -72,6 +81,13 @@ __all__ = [
     "save_calibration",
     "load_calibration",
     "default_params",
+    "backend_fingerprint",
+    "plan_cache_key",
+    "CALIBRATION_SCHEMA",
+    "TunedPlan",
+    "analytic_shortlist",
+    "autotune_plan",
+    "DEFAULT_CODECS",
     "Candidate",
     "Plan",
     "candidate_topologies",

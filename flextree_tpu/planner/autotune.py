@@ -1,0 +1,275 @@
+"""Measured plan autotuner: close the analytic -> measured loop.
+
+"Revisiting the Time Cost Model of AllReduce" (PAPERS.md) argues α-β
+models must be anchored to measurement; ``calibrate.py`` does that for
+the model's *constants* but the final plan pick was still pure argmin.
+This module finishes the loop: take the top-K **analytic** candidates
+over the (tree shape x wire codec) product, time each with the bench
+harness's shuffled-interleaved rep protocol on the live backend, pick the
+**measured** winner, and persist it in a plan cache so the second run is
+a pure cache hit.
+
+Cache contract: entries are keyed by ``plan_cache_key(fingerprint, n,
+nbytes, dtype, codecs)`` — the same fingerprint helper the calibration
+file uses (``calibrate.backend_fingerprint``), so a plan measured on one
+host/chip is never silently replayed on another; a fingerprint mismatch
+is a miss and the candidates are re-measured.  The cache file is JSON
+(an explicit ``cache_path``, else ``FLEXTREE_PLAN_CACHE``, else the
+user-level :data:`DEFAULT_CACHE_PATH` — persistence must hold out of the
+box), one entry per key, schema-versioned like CALIBRATION.json.
+
+The measured winner can only improve on the analytic argmin: the argmin
+is always in the shortlist, so ``min(measured)`` is never slower than the
+argmin's own measured time (asserted in ``tests/test_autotune.py`` with
+an injected fake timer, alongside the first-run-measures /
+second-run-cache-hits demo).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+
+from ..schedule.stages import LonelyTopology, Topology
+from .calibrate import (
+    CALIBRATION_SCHEMA,
+    backend_fingerprint,
+    default_params,
+    plan_cache_key,
+)
+from .choose import choose_topology
+
+__all__ = [
+    "TunedPlan",
+    "analytic_shortlist",
+    "autotune_plan",
+    "DEFAULT_CODECS",
+]
+
+DEFAULT_CODECS = ("f32", "bf16", "int8")
+
+
+@dataclass(frozen=True)
+class TunedPlan:
+    """Autotuner output: the winning (shape, codec) plus provenance."""
+
+    num_nodes: int
+    nbytes: int
+    dtype: str
+    widths: tuple[int, ...]
+    lonely: int
+    codec: str
+    predicted_us: float
+    measured_us: float | None
+    source: str  # "measured" | "cache" | "analytic"
+    #: ranked shortlist rows: (widths, lonely, codec, predicted_us, measured_us)
+    table: tuple = ()
+
+    def to_ft_topo(self) -> str:
+        spec = ",".join(map(str, self.widths))
+        if self.lonely:
+            spec += f"+{self.lonely}"
+        return spec
+
+    @property
+    def topology(self):
+        if self.widths == (1,):
+            return Topology.ring(self.num_nodes)
+        if self.lonely:
+            return LonelyTopology(
+                self.num_nodes,
+                Topology(self.num_nodes - self.lonely, self.widths),
+                self.lonely,
+            )
+        return Topology(self.num_nodes, self.widths)
+
+
+def analytic_shortlist(
+    n: int,
+    nbytes: int,
+    codecs=DEFAULT_CODECS,
+    params=None,
+    top_k: int = 4,
+) -> list[tuple[tuple[int, ...], int, str, float]]:
+    """Top-K ``(widths, lonely, codec, predicted_us)`` over the shape x
+    codec product, cheapest first.  The overall analytic argmin is rank 0
+    by construction."""
+    if params is None:
+        params = default_params()
+    rows: list[tuple[tuple[int, ...], int, str, float]] = []
+    for codec in codecs:
+        plan = choose_topology(n, nbytes, params=params, codec=codec)
+        for c in plan.candidates:
+            rows.append((c.widths, c.lonely, codec, c.total_us))
+    rows.sort(key=lambda r: r[3])
+    return rows[: max(1, top_k)]
+
+
+# ------------------------------------------------------------- cache
+
+
+#: Default on-disk plan cache when neither ``cache_path`` nor
+#: ``FLEXTREE_PLAN_CACHE`` names one — persistence is the documented
+#: contract ("the second run is a pure cache hit"), so it must hold out
+#: of the box, not only for users who exported an env var.  Entries are
+#: keyed by backend fingerprint, so a shared user-level cache is safe
+#: across hosts/backends.
+DEFAULT_CACHE_PATH = os.path.join(
+    os.path.expanduser("~"), ".cache", "flextree_tpu", "plan_cache.json"
+)
+
+
+def _cache_path(cache_path):
+    if cache_path is not None:
+        return cache_path
+    return os.environ.get("FLEXTREE_PLAN_CACHE") or DEFAULT_CACHE_PATH
+
+
+def _cache_load(path) -> dict:
+    if not path or not os.path.exists(path):
+        return {"schema": CALIBRATION_SCHEMA, "entries": {}}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {"schema": CALIBRATION_SCHEMA, "entries": {}}
+    if doc.get("schema", 1) > CALIBRATION_SCHEMA:
+        return {"schema": CALIBRATION_SCHEMA, "entries": {}}
+    doc.setdefault("entries", {})
+    return doc
+
+
+def _cache_store(path, doc) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+
+
+# ------------------------------------------------------------ measure
+
+
+def _default_timer(candidates, n, nbytes, dtype, repeat):
+    """Measure every candidate with the bench harness's shuffled-
+    interleaved protocol (one warmed jitted fn per candidate, reps
+    interleaved in shuffled rounds so a host-contention episode cannot
+    land on one candidate — the BENCH_ALLREDUCE r03/r04 lesson).
+    Returns measured seconds per candidate, aligned with ``candidates``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from ..bench.harness import _interleaved_times
+    from ..parallel.compressed import compressed_allreduce
+    from ..parallel.mesh import flat_mesh
+
+    mesh = flat_mesh(n, "ft")
+    size = max(1, nbytes // jnp.dtype(dtype).itemsize)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((n, size)).astype(np.float32), dtype=jnp.dtype(dtype)
+    )
+
+    calls = {}
+    for i, (widths, lonely, codec, _pred) in enumerate(candidates):
+        spec = ",".join(map(str, widths)) + (f"+{lonely}" if lonely else "")
+
+        def device_fn(row, spec=spec, codec=codec):
+            return compressed_allreduce(row[0], "ft", topo=spec, codec=codec)[None]
+
+        fn = jax.jit(
+            jax.shard_map(
+                device_fn, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"),
+                check_vma=False,
+            )
+        )
+        jax.block_until_ready(fn(x))  # compile outside the timed reps
+        calls[str(i)] = (fn, (x,))
+    rows = _interleaved_times(calls, repeat)
+    return [rows[str(i)]["min_ms"] * 1e-3 for i in range(len(candidates))]
+
+
+# ------------------------------------------------------------- entry
+
+
+def autotune_plan(
+    n: int,
+    nbytes: int,
+    *,
+    dtype: str = "float32",
+    codecs=DEFAULT_CODECS,
+    top_k: int = 4,
+    params=None,
+    cache_path=None,
+    timer=None,
+    repeat: int = 5,
+    use_cache: bool = True,
+) -> TunedPlan:
+    """Pick the gradient-sync plan by measurement.
+
+    First run: rank the shape x codec product analytically, measure the
+    top-``top_k`` candidates (``timer(candidates, n, nbytes, dtype,
+    repeat) -> [seconds]``, defaulting to the live-backend protocol
+    above), persist the winner under the backend-fingerprinted key.
+    Second run with the same key: pure cache hit — no timing, no compile.
+
+    ``codecs=("f32",)`` tunes shape only (the measured twin of
+    ``choose_topology``); the default product also offers the wire codecs
+    so the planner can trade shape against precision.
+    """
+    codecs = tuple(codecs)
+    shortlist = analytic_shortlist(n, nbytes, codecs, params=params, top_k=top_k)
+    fp = backend_fingerprint()
+    key = plan_cache_key(fp, f"n{n}", f"{nbytes}B", dtype, ",".join(codecs))
+    path = _cache_path(cache_path)
+
+    if use_cache and path:
+        doc = _cache_load(path)
+        hit = doc["entries"].get(key)
+        if hit is not None and hit.get("fingerprint") == fp:
+            return TunedPlan(
+                n, nbytes, dtype,
+                tuple(hit["widths"]), int(hit.get("lonely", 0)), hit["codec"],
+                float(hit["predicted_us"]), float(hit["measured_us"]),
+                source="cache",
+                table=tuple(tuple(r) for r in hit.get("table", ())),
+            )
+
+    if timer is None:
+        timer = _default_timer
+    measured_s = timer(shortlist, n, nbytes, dtype, repeat)
+    if len(measured_s) != len(shortlist):
+        raise ValueError(
+            f"timer returned {len(measured_s)} times for "
+            f"{len(shortlist)} candidates"
+        )
+    table = tuple(
+        (widths, lonely, codec, pred, t * 1e6)
+        for (widths, lonely, codec, pred), t in zip(shortlist, measured_s)
+    )
+    best_i = min(range(len(shortlist)), key=lambda i: measured_s[i])
+    widths, lonely, codec, pred = shortlist[best_i]
+    plan = TunedPlan(
+        n, nbytes, dtype, widths, lonely, codec, pred,
+        measured_s[best_i] * 1e6, source="measured", table=table,
+    )
+    if use_cache and path:
+        doc = _cache_load(path)
+        doc["entries"][key] = {
+            "fingerprint": fp,
+            "widths": list(widths),
+            "lonely": lonely,
+            "codec": codec,
+            "predicted_us": pred,
+            "measured_us": plan.measured_us,
+            "table": [
+                [list(w), l, c, p, m] for (w, l, c, p, m) in table
+            ],
+        }
+        _cache_store(path, doc)
+    return plan

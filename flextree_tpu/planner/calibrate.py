@@ -44,7 +44,59 @@ __all__ = [
     "save_calibration",
     "load_calibration",
     "default_params",
+    "backend_fingerprint",
+    "plan_cache_key",
+    "CALIBRATION_SCHEMA",
 ]
+
+#: Schema version written into every calibration section (and every
+#: autotune plan-cache entry).  Bump when the on-disk format changes;
+#: loaders refuse sections from a NEWER schema rather than misparse them.
+CALIBRATION_SCHEMA = 2
+
+
+def backend_fingerprint() -> str | None:
+    """Stable identity of the measuring backend: platform, device kind,
+    device count and jax version — the key that keeps constants measured
+    on one host from silently pricing another (a 1-core CPU fit must
+    never cost a TPU fabric, and a v5e fit must not cost a v4).
+
+    Deliberately built from the *device*, not from a section name:
+    calibration sections may be named more specifically than jax platform
+    names (``tpu_v5e`` vs ``tpu``), and that naming granularity must not
+    defeat the check (or the prefix-fallback lookup).
+
+    Returns None when no backend is initialized and none can be described
+    — callers then skip the check rather than guess.  Like
+    ``default_params``, this never *initializes* a backend itself.
+    """
+    import sys
+
+    if "jax" not in sys.modules:
+        return None
+    jax = sys.modules["jax"]
+    try:
+        if not jax._src.xla_bridge._backends:  # not initialized: stay lazy
+            return None
+        devs = jax.devices()
+        kind = getattr(devs[0], "device_kind", devs[0].platform)
+        return "|".join(
+            [
+                devs[0].platform,
+                str(kind),
+                f"n{len(devs)}",
+                f"jax{jax.__version__}",
+            ]
+        )
+    except Exception:  # noqa: BLE001 — fingerprinting must never raise
+        return None
+
+
+def plan_cache_key(*parts) -> str:
+    """Join key components into the flat string key both the calibration
+    fingerprint check and the autotune plan cache use — one helper so the
+    two caches cannot diverge in how they identify a measurement context."""
+    return "|".join("~" if p is None else str(p) for p in parts)
 
 
 @dataclass(frozen=True)
@@ -223,6 +275,7 @@ def _params_to_dict(p: TpuCostParams) -> dict:
         "reduce_bw_GBps": p.reduce_bw_GBps,
         "control_us_per_width": p.control_us_per_width,
         "launch_us": p.launch_us,
+        "codec_bw_GBps": p.codec_bw_GBps,
     }
 
 
@@ -233,17 +286,30 @@ def _params_from_dict(d: dict) -> TpuCostParams:
         reduce_bw_GBps=d["reduce_bw_GBps"],
         control_us_per_width=d["control_us_per_width"],
         launch_us=d["launch_us"],
+        # schema-1 files predate the codec term: fall back to the default
+        codec_bw_GBps=d.get("codec_bw_GBps", TpuCostParams.codec_bw_GBps),
     )
 
 
 def save_calibration(
-    path, params: TpuCostParams, *, backend: str, meta: dict | None = None
+    path,
+    params: TpuCostParams,
+    *,
+    backend: str,
+    meta: dict | None = None,
+    fingerprint: str | None = None,
 ) -> None:
     """Write/merge the ``backend`` section of a CALIBRATION.json file.
 
     ``meta`` should say where the numbers came from (protocol, host,
     measured points, date) — the file is a committed artifact and each
     constant must be traceable to a measurement or labeled as a default.
+
+    Every section is stamped with ``schema`` (:data:`CALIBRATION_SCHEMA`)
+    and the measuring backend's ``fingerprint``
+    (:func:`backend_fingerprint` unless given explicitly), so a fit from
+    one host is never silently reused on another — ``load_calibration``
+    rejects mismatches.
     """
     import json
     import os
@@ -252,12 +318,19 @@ def save_calibration(
     if os.path.exists(path):
         with open(path) as f:
             doc = json.load(f)
-    doc[backend] = {"params": _params_to_dict(params), "meta": meta or {}}
+    doc[backend] = {
+        "schema": CALIBRATION_SCHEMA,
+        "fingerprint": fingerprint or backend_fingerprint(),
+        "params": _params_to_dict(params),
+        "meta": meta or {},
+    }
     with open(path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
 
 
-def load_calibration(path, *, backend: str) -> TpuCostParams | None:
+def load_calibration(
+    path, *, backend: str, fingerprint: str | None = None
+) -> TpuCostParams | None:
     """Load the ``backend`` section; None if the file/section is absent.
 
     Section names may be more specific than jax platform names (the file
@@ -266,9 +339,20 @@ def load_calibration(path, *, backend: str) -> TpuCostParams | None:
     as a prefix — measured TPU constants must not be silently dropped
     because of a naming-granularity mismatch.  Ambiguity (two ``tpu_*``
     sections) stays a miss: guessing between chips would be worse.
+
+    Fingerprint check: when the section carries one AND the current
+    backend's fingerprint is determinable (``fingerprint`` argument, else
+    :func:`backend_fingerprint`), a mismatch is a **miss** — constants
+    fitted on another host/chip must not silently price this one.
+    Sections written before the fingerprint era (no ``fingerprint`` key)
+    load with a warning: not silent, and the committed per-backend section
+    names still gate the platform.  Sections from a NEWER schema are
+    rejected outright rather than misparsed.
     """
     import json
     import os
+
+    from ..utils.logging import get_logger
 
     if not path or not os.path.exists(path):
         return None
@@ -279,7 +363,33 @@ def load_calibration(path, *, backend: str) -> TpuCostParams | None:
         prefixed = [k for k in doc if k.startswith(backend + "_")]
         if len(prefixed) == 1:
             sec = doc[prefixed[0]]
-    return _params_from_dict(sec["params"]) if sec else None
+    if not sec:
+        return None
+    log = get_logger("flextree.planner")
+    if sec.get("schema", 1) > CALIBRATION_SCHEMA:
+        log.warning(
+            "calibration %s section %r has schema %s > supported %s; ignoring",
+            path, backend, sec.get("schema"), CALIBRATION_SCHEMA,
+        )
+        return None
+    saved_fp = sec.get("fingerprint")
+    if saved_fp is None:
+        log.warning(
+            "calibration %s section %r predates fingerprinting; loading "
+            "unverified (re-run tools/calibrate_host.py to stamp it)",
+            path, backend,
+        )
+    else:
+        current_fp = fingerprint or backend_fingerprint()
+        if current_fp is not None and current_fp != saved_fp:
+            log.warning(
+                "calibration %s section %r was fitted on %r but this "
+                "backend is %r; ignoring it (re-run tools/calibrate_host.py "
+                "on this host)",
+                path, backend, saved_fp, current_fp,
+            )
+            return None
+    return _params_from_dict(sec["params"])
 
 
 def default_params(backend: str | None = None) -> TpuCostParams:

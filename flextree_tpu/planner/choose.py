@@ -144,6 +144,7 @@ def choose_topology(
     params: TpuCostParams | None = None,
     mesh_shape: tuple[int, ...] | None = None,
     dcn_axes: tuple[int, ...] = (),
+    codec=None,
 ) -> Plan:
     """Pick the cheapest topology for ``n`` devices and ``nbytes``/chip.
 
@@ -153,6 +154,13 @@ def choose_topology(
     single-axis rings, which is optimistic — alignment is reported so the
     caller can filter).  ``dcn_axes``: indices of mesh axes that are DCN
     (multi-slice outer axes).
+
+    ``codec``: wire codec for the collective (``ops/quantize.py``); the
+    argmin then trades shape against the codec's wire ratio and per-hop
+    encode/decode cost.  ``None``/``"f32"`` reproduces the uncompressed
+    costing exactly.  The codec x shape product is searched by
+    ``planner.autotune.autotune_plan``, which measures the analytic
+    shortlist instead of trusting it.
     """
     if n < 1:
         raise ValueError(f"n must be >= 1, got {n}")
@@ -177,14 +185,19 @@ def choose_topology(
         mesh_shape = tuple(mesh_shape[i] for i in keep) or None
     if n == 1:
         t = Topology.flat(1)
-        return Plan(1, nbytes, t, (Candidate((1,), allreduce_cost(t, nbytes, params)),))
+        return Plan(
+            1, nbytes, t,
+            (Candidate((1,), allreduce_cost(t, nbytes, params, codec=codec)),),
+        )
 
     cands: list[Candidate] = []
     for widths in candidate_topologies(n):
         if widths == (1,):
             from .cost_model import ring_cost
 
-            cost = ring_cost(n, nbytes, params, crosses_dcn=bool(dcn_axes))
+            cost = ring_cost(
+                n, nbytes, params, crosses_dcn=bool(dcn_axes), codec=codec
+            )
             cands.append(Candidate((1,), cost, False))
             continue
         topo = Topology(n, widths)
@@ -203,7 +216,9 @@ def choose_topology(
                 # (pessimistic) so misaligned shapes can't win on an
                 # optimistic ICI-only estimate
                 dcn_stages = tuple(range(len(widths)))
-        cost = allreduce_cost(topo, nbytes, params, dcn_stages=dcn_stages)
+        cost = allreduce_cost(
+            topo, nbytes, params, dcn_stages=dcn_stages, codec=codec
+        )
         cands.append(Candidate(widths, cost, aligned))
 
     advisory: tuple[str, ...] = ()
@@ -224,7 +239,7 @@ def choose_topology(
             dcn_lonely = tuple(range(len(widths))) if dcn_axes else ()
             cost = lonely_allreduce_cost(
                 tree, 1, nbytes, params, dcn_stages=dcn_lonely,
-                buddy_crosses_dcn=bool(dcn_axes),
+                buddy_crosses_dcn=bool(dcn_axes), codec=codec,
             )
             cands.append(Candidate(widths, cost, False, lonely=1))
         near = []
@@ -261,6 +276,7 @@ def choose_bucket_bytes(
     n_leaves: int | None = None,
     params: TpuCostParams | None = None,
     max_buckets: int = 64,
+    codec=None,
 ) -> int:
     """Cost-model-driven gradient-bucket size: the fused-sync bucket cap
     that minimizes predicted sync time for ``nbytes`` of gradients.
@@ -303,14 +319,18 @@ def choose_bucket_bytes(
 
     def cost(t, nb):
         if isinstance(t, LonelyTopology):
-            return lonely_allreduce_cost(t.tree, t.lonely, nb, params)
-        return allreduce_cost(t, nb, params)
+            return lonely_allreduce_cost(t.tree, t.lonely, nb, params, codec=codec)
+        return allreduce_cost(t, nb, params, codec=codec)
 
     fixed = byte_us = 0.0
     for t in topo_list:
         fixed += cost(t, 0).total_us
         full = cost(t, nbytes)
-        byte_us += full.bandwidth_us + full.reduce_us
+        # codec_us is byte-proportional (encode/decode passes), so a
+        # compressed sync amortizes it across buckets exactly like
+        # bandwidth — the argmin shifts toward fewer, larger buckets as
+        # the wire gets cheaper relative to the fixed launch cost
+        byte_us += full.bandwidth_us + full.reduce_us + full.codec_us
     k_max = max(1, min(max_buckets, n_leaves or max_buckets))
     best_k, best_t = 1, float("inf")
     for k in range(1, k_max + 1):

@@ -35,6 +35,8 @@ def build(args):
         warmup_steps=args.warmup_steps,
         total_steps=args.steps if args.schedule == "warmup_cosine" else 0,
         min_lr_frac=args.min_lr_frac,
+        codec=args.codec,
+        autotune=args.autotune,
     )
     key = jax.random.PRNGKey(args.seed)
     mesh_shape = (
@@ -61,10 +63,10 @@ def build(args):
         cfg = TransformerConfig(**common)
         mesh = make_mesh_3d(args.devices, mesh_shape)
         return (
-            init_train_state(key, cfg),
+            init_train_state(key, cfg, tc),
             make_train_step(mesh, cfg, tc),
             mesh,
-            state_specs(cfg),
+            state_specs(cfg, train_cfg=tc),
         )
     if args.model == "pipeline":
         from .parallel.pipeline import (
@@ -77,12 +79,12 @@ def build(args):
         cfg = TransformerConfig(**common)
         mesh = make_mesh_4d(args.devices, mesh_shape)
         return (
-            init_pipeline_train_state(key, cfg),
+            init_pipeline_train_state(key, cfg, tc),
             make_pipeline_train_step(
                 mesh, cfg, tc, n_microbatches=args.microbatches
             ),
             mesh,
-            pipeline_state_specs(cfg),
+            pipeline_state_specs(cfg, train_cfg=tc),
         )
     if args.model == "moe":
         from .models.moe import MoEConfig
@@ -101,10 +103,10 @@ def build(args):
         )
         mesh = make_mesh_moe(args.devices, mesh_shape)
         return (
-            init_moe_train_state(key, cfg),
+            init_moe_train_state(key, cfg, tc),
             make_moe_train_step(mesh, cfg, tc),
             mesh,
-            moe_state_specs(cfg),
+            moe_state_specs(cfg, train_cfg=tc),
         )
     raise ValueError(f"unknown model {args.model!r}")
 
@@ -149,6 +151,20 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--grad-topo", type=str, default=None,
                     help="FT_TOPO-style widths for the gradient allreduce")
+    ap.add_argument(
+        "--codec", choices=["f32", "bf16", "int8"], default="f32",
+        help="gradient-sync wire codec (docs/QUANTIZED_COLLECTIVES.md): "
+        "f32 = identity (bitwise-identical sync), bf16/int8 compress the "
+        "collective payload per hop with an error-feedback residual "
+        "carried in the train state",
+    )
+    ap.add_argument(
+        "--autotune", action="store_true",
+        help="pick the gradient-sync topology by measuring the analytic "
+        "top-K candidates on this backend (planner/autotune.py) instead "
+        "of trusting the cost-model argmin; cached under "
+        "FLEXTREE_PLAN_CACHE so the next run is a pure cache hit",
+    )
     ap.add_argument("--mesh", type=str, default=None,
                     help="comma mesh shape, e.g. 2,2,2 (dense) or 1,2,2,2")
     ap.add_argument("--devices", type=int, default=None)

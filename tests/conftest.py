@@ -23,6 +23,13 @@ import os
 # leak into the suite
 os.environ.pop("FLEXTREE_CALIBRATION", None)
 os.environ.pop("FLEXTREE_CALIBRATION_BACKEND", None)
+# likewise the autotune plan cache: tests must never read or write the
+# developer's user-level default cache — pin it to a per-run temp file
+import tempfile as _tempfile
+
+os.environ["FLEXTREE_PLAN_CACHE"] = os.path.join(
+    _tempfile.gettempdir(), f"flextree_plan_cache_test_{os.getpid()}.json"
+)
 
 import jax
 

@@ -43,7 +43,9 @@ def cpu_section(out: str) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from flextree_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(8)  # both config spellings (this pin lacks the new one)
     from flextree_tpu.planner import (
         fit_cost_params,
         measure_points,
