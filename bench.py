@@ -470,6 +470,59 @@ print("QUANT_JSON: " + json.dumps(
 '''
 
 
+_OVERLAP_TRIPWIRE_CODE = r'''
+import json, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from flextree_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(8)
+from flextree_tpu.bench.harness import TrainStepBenchConfig, run_train_step_bench
+
+# run_train_step_bench RAISES if any sync variant (incl. ours_overlapped /
+# ours_overlap_serialized) diverges bitwise from per-leaf, so reaching the
+# print line at all certifies the identity contract on this exact tree
+out = run_train_step_bench(
+    TrainStepBenchConfig(n_layers=2, repeat=4, supervised=False, overlap=True)
+)
+rows = out["rows"]
+twin = rows["ours_overlap_serialized"]["exposed_comm_ms"]
+ovl = rows["ours_overlapped"]["exposed_comm_ms"]
+frac = ovl / twin if twin > 0 else 1.0
+print("OVERLAP_JSON: " + json.dumps({{
+    "overlap_bitwise_violations": 0 if out["identical"] else 1,
+    "overlap_exposed_comm_frac": round(frac, 3),
+}}))
+'''
+
+
+def run_overlap_tripwire(timeout_s: int = 300) -> dict:
+    """Supplementary keys ``overlap_bitwise_violations`` (the overlapped
+    and barrier-serialized train steps' updated params bitwise-equal to
+    per-leaf on this exact tree; 0 = identical) and
+    ``overlap_exposed_comm_frac`` (in-process exposed comm of the
+    overlapped step as a fraction of its serialized twin's — informational
+    on a single-address-space mesh, where the wire is a memcpy on the
+    compute cores; the enforced >=1.3x floor lives on the real 2-process
+    wire in tools/bench_overlap.py -> BENCH_OVERLAP.json).
+    Subprocess-guarded: absent keys read as "not verified", never "clean".
+    """
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _OVERLAP_TRIPWIRE_CODE.format(repo=REPO)],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in p.stdout.splitlines():
+            if line.startswith("OVERLAP_JSON: "):
+                return json.loads(line[len("OVERLAP_JSON: "):])
+        return {
+            "overlap_error": f"no OVERLAP_JSON (rc={p.returncode}); "
+            f"stderr tail: {p.stderr[-200:]}"
+        }
+    except (subprocess.SubprocessError, OSError, ValueError) as e:
+        return {"overlap_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def run_quantize_tripwire(timeout_s: int = 240) -> dict:
     """Supplementary keys ``quant_error_bound_violations`` (compressed
     allreduce error vs the documented codec bound on this exact tree; 0 =
@@ -559,6 +612,7 @@ def main() -> int:
         result.update(run_static_analysis_tripwire())
         result.update(run_runtime_report_tripwire())
         result.update(run_quantize_tripwire())
+        result.update(run_overlap_tripwire())
     print(json.dumps(result))
     return 0
 

@@ -38,6 +38,7 @@ __all__ = [
     "collective_operand_dtypes",
     "lint_ir",
     "lower_entrypoints",
+    "overlap_sync_budget",
     "run_hlo_lint",
 ]
 
@@ -80,6 +81,15 @@ class HloBudget:
     #: before the collective keeps the numerics quantized but silently
     #: multiplies the wire bytes back up (violation kind "codec-upcast")
     require_wire_dtype: str | None = None
+    #: overlapped entrypoints: backward compute (dot_general) must appear
+    #: AFTER the first scheduled sync collective in program order — the
+    #: readiness-ordered step issues each bucket's collective mid-backward,
+    #: so a program whose collectives all trail the last matmul has
+    #: reintroduced the full-backward barrier (violation kind
+    #: "overlap-serialization"; StableHLO emission preserves trace order,
+    #: so the check is a pure text-order one).  Only meaningful on
+    #: entrypoints whose forward has no collectives (dp-only meshes).
+    require_compute_after_collective: bool = False
     note: str = ""
 
 
@@ -162,6 +172,30 @@ def lint_ir(name: str, ir: str, budget: HloBudget) -> list[Violation]:
                     f"the wire (saw {sorted(seen)}): the codec was decoded "
                     f"before the collective — numerics stay quantized while "
                     f"the wire bytes silently multiply back up",
+                )
+            )
+    if budget.require_compute_after_collective:
+        lines = ir.splitlines()
+        first_coll = None
+        last_dot = None
+        for i, line in enumerate(lines):
+            if first_coll is None and (
+                '"stablehlo.reduce_scatter"' in line
+                or '"stablehlo.all_to_all"' in line
+            ):
+                first_coll = i
+            if "stablehlo.dot_general" in line:
+                last_dot = i
+        if first_coll is None or last_dot is None or last_dot < first_coll:
+            out.append(
+                Violation(
+                    "hlo",
+                    "overlap-serialization",
+                    name,
+                    "no backward compute (dot_general) follows the first "
+                    "sync collective: every collective trails the full "
+                    "backward — the readiness-ordered overlap has been "
+                    "serialized behind a full-backward barrier",
                 )
             )
     if budget.require_donation and "jax.buffer_donor" not in ir:
@@ -336,6 +370,80 @@ def bucketed_sync_budget() -> tuple[int, int]:
     expected = sum(len(b.axes) for b in buckets)
     n_synced = sum(1 for s in flat_s if replication_key(s, ("dp", "sp", "tp")))
     return expected, n_synced
+
+
+def _lower_overlap_train_step(
+    serialize: bool = False, codec: str = "f32"
+) -> str:
+    """Lower the readiness-ordered overlapped dense step (or, with
+    ``serialize=True``, its full-backward-barrier twin) on a dp-only
+    8-device mesh — tp=sp=1, so the forward emits NO collectives and
+    every scheduled collective in the program belongs to the gradient
+    sync (the precondition for ``require_compute_after_collective``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_nd,
+        make_train_step,
+    )
+
+    model_cfg = _small_model_cfg()
+    mesh = make_mesh_nd(8, (8, 1, 1), ("dp", "sp", "tp"))
+    # explicit inner cap AND explicit flat topology so the budget is
+    # environment-independent: one collective per fired boundary bucket,
+    # immune to an ambient FT_TOPO (grad_topo=None would resolve through
+    # the env var and diverge from overlap_sync_budget's flat(8) plan)
+    train_cfg = TrainConfig(
+        overlap=True, codec=codec, bucket_bytes=1 << 30, grad_topo="8"
+    )
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg, train_cfg),
+        jax.random.PRNGKey(0),
+    )
+    tok = jax.ShapeDtypeStruct((8, 32), jnp.int32)
+    step = make_train_step(
+        mesh, model_cfg, train_cfg, serialize_overlap=serialize
+    )
+    return step.lower(state_sds, tok, tok).as_text()
+
+
+def overlap_sync_budget(codec: str = "f32") -> tuple[int, int]:
+    """(number of fired overlap buckets, number of readiness segments)
+    for the overlapped dense entrypoint above, from the very plan the
+    step executes at trace time (``parallel.overlap.plan_overlap``) — so
+    the collective-count budget tracks the planner, not a hand-kept
+    constant.  On the dp-only mesh every bucket is one (dp, f32) group:
+    one scheduled tree collective per bucket (rs+ag pair for the identity
+    codec; grouped a2a/ag pairs for int8)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.quantize import get_codec
+    from ..parallel.overlap import plan_overlap
+    from ..parallel.train import TrainConfig, init_train_state, state_specs
+    from ..schedule.stages import Topology
+
+    model_cfg = _small_model_cfg()
+    train_cfg = TrainConfig(overlap=True, codec=codec, bucket_bytes=1 << 30)
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg, train_cfg),
+        jax.random.PRNGKey(0),
+    )
+    pspecs = state_specs(model_cfg, "tp")["params"]
+    c = get_codec(codec)
+    # n_tokens/t_local are PER-DEVICE (inside shard_map the (8, 32) batch
+    # shards to (1, 32) on the dp-8 mesh) — must match the traced values
+    plan = plan_overlap(
+        state_sds["params"], pspecs, ("dp", "sp", "tp"),
+        {"dp": Topology.flat(8), "sp": None, "tp": None},
+        {"dp": 8, "sp": 1, "tp": 1},
+        n_tokens=32, t_local=32, d_model=model_cfg.d_model,
+        codec=c if c.lossy else None,
+    )
+    return plan.n_buckets, len(plan.labels)
 
 
 def _lower_moe_step() -> str:
@@ -526,6 +634,53 @@ def lower_entrypoints(full: bool = True) -> list[tuple[str, str, HloBudget]]:
             ),
         )
     )
+
+    # readiness-ordered overlap (ISSUE 6): the overlapped step and its
+    # full-backward-barrier twin carry the SAME collective-count budget —
+    # overlap must relocate collectives, never add or drop them — and the
+    # overlapped one must actually interleave them with backward compute
+    n_buckets, n_segments = overlap_sync_budget()
+    overlap_budget = dict(
+        reduce_scatter=n_buckets, all_gather=n_buckets,
+        collective_permute=0,
+        note=(
+            f"sync collectives scale with the {n_buckets} planned overlap "
+            f"buckets over {n_segments} readiness segments; counts must "
+            f"equal the serialized twin's"
+        ),
+    )
+    rows.append(
+        (
+            "train_step_overlapped",
+            _lower_overlap_train_step(serialize=False),
+            HloBudget(require_compute_after_collective=True, **overlap_budget),
+        )
+    )
+    rows.append(
+        (
+            "train_step_overlap_serialized",
+            _lower_overlap_train_step(serialize=True),
+            HloBudget(**overlap_budget),
+        )
+    )
+    n_buckets_i8, _ = overlap_sync_budget("int8")
+    rows.append(
+        (
+            "train_step_overlapped_int8",
+            _lower_overlap_train_step(codec="int8"),
+            HloBudget(
+                reduce_scatter=0, all_to_all=2 * n_buckets_i8,
+                collective_dtypes=None,
+                require_wire_dtype="i8",
+                require_compute_after_collective=True,
+                note=(
+                    "overlapped int8 sync keeps the wire dtype: grouped "
+                    "(i8 payload, f32 scales) all_to_alls fired "
+                    "mid-backward, never a decoded f32 collective"
+                ),
+            ),
+        )
+    )
     return rows
 
 
@@ -561,6 +716,27 @@ def lower_leaf_unrolled_train_step() -> tuple[str, HloBudget]:
         all_reduce=native["all_reduce"] + expected_sync,
         exact=False,
         note=f"bucketed budget applied to a per-leaf ({n_synced}-leaf) sync",
+    )
+    return ir, budget
+
+
+def lower_overlap_serialized_train_step() -> tuple[str, HloBudget]:
+    """The 'overlap-serialization' corruption: the overlapped train step
+    with the full-backward barrier reintroduced before the first
+    collective (``make_train_step(serialize_overlap=True)``) lowered
+    against the *overlapped* budget.  Numerically bitwise-identical to
+    the overlapped step — only the linter's program-order check can see
+    that every collective now trails the backward, un-hiding all the wire
+    time the overlap tentpole exists to hide."""
+    _require_devices(8)
+    n_buckets, n_segments = overlap_sync_budget()
+    ir = _lower_overlap_train_step(serialize=True)
+    budget = HloBudget(
+        reduce_scatter=n_buckets, all_gather=n_buckets,
+        collective_permute=0,
+        require_compute_after_collective=True,
+        note=f"overlapped budget applied to the {n_segments}-segment "
+             f"barrier twin",
     )
     return ir, budget
 

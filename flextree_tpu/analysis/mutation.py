@@ -22,6 +22,7 @@ watchdog-removal      1       ``unbounded-wait`` (lost recv deadline)
 leaf-unrolled         2       ``budget``
 dtype-drift           2       ``dtype-drift``
 codec-upcast          2       ``codec-upcast``
+overlap-serialization 2       ``overlap-serialization``
 wall-clock            3       ``wall-clock``
 host-rng              3       ``rng``
 traced-branch         3       ``traced-branch``
@@ -161,6 +162,13 @@ def _mutate_codec_upcast():
     return lint_ir("mutated:codec_upcast_allreduce", ir, budget)
 
 
+def _mutate_overlap_serialization():
+    from .hlo_lint import lint_ir, lower_overlap_serialized_train_step
+
+    ir, budget = lower_overlap_serialized_train_step()
+    return lint_ir("mutated:overlap_serialized_train_step", ir, budget)
+
+
 # ----------------------------------------------------- layer 3 mutations
 
 _HYGIENE_MUTANT = '''
@@ -204,6 +212,9 @@ MUTATIONS = {
     "leaf-unrolled": ("budget", "hlo", _mutate_leaf_unrolled),
     "dtype-drift": ("dtype-drift", "hlo", _mutate_dtype_drift),
     "codec-upcast": ("codec-upcast", "hlo", _mutate_codec_upcast),
+    "overlap-serialization": (
+        "overlap-serialization", "hlo", _mutate_overlap_serialization,
+    ),
     "wall-clock": ("wall-clock", "jit", _mutate_hygiene("wall-clock")),
     "host-rng": ("rng", "jit", _mutate_hygiene("rng")),
     "traced-branch": ("traced-branch", "jit", _mutate_hygiene("traced-branch")),

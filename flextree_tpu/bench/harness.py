@@ -56,6 +56,7 @@ __all__ = [
     "run_grad_sync_bench",
     "TrainStepBenchConfig",
     "run_train_step_bench",
+    "make_nosync_train_step",
 ]
 
 log = get_logger("flextree.bench")
@@ -434,6 +435,72 @@ class TrainStepBenchConfig:
     # worker thread + heartbeat Supervisor fed per-step durations) — the
     # fault-free overhead the ISSUE-4 acceptance bounds at <= 2%
     supervised: bool = True
+    # add the readiness-ordered overlap rows (ISSUE 6): ``no_sync`` (the
+    # same forward/backward/AdamW with the gradient sync elided — the
+    # exposure baseline), ``ours_overlapped`` (TrainConfig(overlap=True))
+    # and ``ours_overlap_serialized`` (its full-backward-barrier twin —
+    # equal collective counts, bitwise-equal results).  Every sync row
+    # then carries ``exposed_comm_ms`` (step-time delta over no_sync);
+    # the overlapped row also carries ``hidden_comm_ms`` = the twin's
+    # exposure minus its own — wire time that ran under backward compute.
+    # Default False: the overlapped step is the slowest compile in the
+    # suite (one vjp per layer) and pre-existing callers' artifacts
+    # (BENCH_BUCKETING.json) keep their historical row schema.
+    overlap: bool = False
+
+
+def make_nosync_train_step(mesh, model_cfg, train_cfg, axis_names=("dp", "sp", "tp")):
+    """The sync-free twin of ``make_train_step``: identical forward,
+    backward and AdamW, gradient sync elided — NOT a training step (the
+    replicas would diverge) but the exposure baseline the overlap bench
+    needs: ``step(with sync) - step(no sync)`` is the sync time that
+    actually extended the step (``utils.profiling.exposed_split``)."""
+    import jax as _jax
+
+    from ..models.transformer import cross_entropy_loss, forward
+    from ..parallel.train import (
+        adamw_apply,
+        maybe_clip_grads,
+        metric_specs,
+        state_specs,
+        validate_tp,
+    )
+
+    dp, sp, tp = axis_names
+    validate_tp(model_cfg, mesh.shape[tp])
+    sspecs = state_specs(model_cfg, tp, train_cfg)
+    data_spec = P(dp, sp)
+
+    def device_step(state, tokens, targets):
+        n_total_tokens = (
+            tokens.size
+            * lax.axis_size(dp)
+            * lax.axis_size(sp)
+            * lax.axis_size(tp)
+        )
+
+        def local_loss(params):
+            logits = forward(params, tokens, model_cfg, tp_axis=tp, sp_axis=sp)
+            loss_sum, _ = cross_entropy_loss(logits, targets)
+            return loss_sum / n_total_tokens
+
+        loss, grads = _jax.value_and_grad(local_loss)(state["params"])
+        global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
+        metrics = {"loss": global_loss}
+        # clip compute stays (compute parity with the real step — only
+        # the SYNC is elided), and it also keeps the metrics pytree
+        # matching metric_specs when clipping is configured
+        grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
+        new_state = adamw_apply(state, grads, train_cfg)
+        return new_state, metrics
+
+    mspec = metric_specs(train_cfg, {"loss": P()})
+    return jax.jit(
+        jax.shard_map(
+            device_step, mesh=mesh, in_specs=(sspecs, data_spec, data_spec),
+            out_specs=(sspecs, mspec), check_vma=False,
+        )
+    )
 
 
 def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
@@ -441,8 +508,11 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
     comm-vs-compute attribution: ``sync_ms`` times the gradient sync alone
     on the model's real gradient tree (the per-bucket ``comm_span`` scopes
     mark the same collectives in profiler traces), so
-    ``step - sync = compute`` is readable per row.  Also asserts the fused
-    step's updated parameters are bitwise-identical to the per-leaf step's.
+    ``step - sync = compute`` is readable per row.  With ``cfg.overlap``,
+    the readiness-ordered rows and the exposed-vs-hidden comm split are
+    added (see :class:`TrainStepBenchConfig`).  Also asserts the fused,
+    chunked and overlapped steps' updated parameters are bitwise-identical
+    to the per-leaf step's.
     """
     from ..models.transformer import TransformerConfig
     from ..parallel.train import (
@@ -507,6 +577,18 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
         states_out[name], _ = jax.block_until_ready(steps[name](state, toks, tgts))
         syncs[name] = make_sync(tc)
         jax.block_until_ready(syncs[name](grads))
+
+    if cfg.overlap:
+        tc_ovl = TrainConfig(grad_topo=cfg.topo, overlap=True)
+        steps["ours_overlapped"] = make_train_step(mesh, model_cfg, tc_ovl)
+        steps["ours_overlap_serialized"] = make_train_step(
+            mesh, model_cfg, tc_ovl, serialize_overlap=True
+        )
+        steps["no_sync"] = make_nosync_train_step(mesh, model_cfg, tc_ovl)
+        for name in ("ours_overlapped", "ours_overlap_serialized", "no_sync"):
+            out, _ = jax.block_until_ready(steps[name](state, toks, tgts))
+            if name != "no_sync":
+                states_out[name] = out
 
     supervised_ctx = None
     if cfg.supervised:
@@ -574,6 +656,45 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
         rows[name]["vs_per_leaf"] = (
             rows["per_leaf"]["train_step_ms"] / rows[name]["train_step_ms"]
         )
+    if cfg.overlap:
+        from ..utils.profiling import exposed_split
+
+        nosync_ms = step_times["no_sync"]["min_ms"]
+        rows["no_sync"] = {
+            "train_step_ms": nosync_ms,
+            "train_step_avg_ms": step_times["no_sync"]["avg_ms"],
+        }
+        # the serialized twin hides nothing, so its exposure IS the
+        # overlapped program's comm total (equal collective counts, equal
+        # payloads) — the comm_total the overlapped row's split is cut by
+        twin_exposed = max(
+            step_times["ours_overlap_serialized"]["min_ms"] - nosync_ms, 0.0
+        )
+        for name in ("ours_overlapped", "ours_overlap_serialized"):
+            exp, hid = exposed_split(
+                step_times[name]["min_ms"], nosync_ms, twin_exposed
+            )
+            rows[name] = {
+                "train_step_ms": step_times[name]["min_ms"],
+                "train_step_avg_ms": step_times[name]["avg_ms"],
+                "exposed_comm_ms": exp,
+                "hidden_comm_ms": hid,
+                "vs_per_leaf": (
+                    rows["per_leaf"]["train_step_ms"]
+                    / step_times[name]["min_ms"]
+                ),
+            }
+        for name in ("per_leaf", "ours_fused", "ours_chunked"):
+            rows[name]["exposed_comm_ms"] = max(
+                step_times[name]["min_ms"] - nosync_ms, 0.0
+            )
+        # clamped denominator: a zero exposure (fully hidden, or noise
+        # crossing zero on this host) must not put Infinity into
+        # artifacts that embed these rows (BENCH_OVERLAP.json)
+        exp_o = rows["ours_overlapped"]["exposed_comm_ms"]
+        rows["ours_overlapped"]["exposed_vs_serialized"] = (
+            twin_exposed / max(exp_o, 0.1)
+        )
     if cfg.supervised:
         t = step_times["ours_fused_supervised"]
         rows["ours_fused_supervised"] = {
@@ -589,7 +710,10 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
         }
 
     identical = True
-    for name in ("ours_fused", "ours_chunked"):
+    variants = ["ours_fused", "ours_chunked"]
+    if cfg.overlap:
+        variants += ["ours_overlapped", "ours_overlap_serialized"]
+    for name in variants:
         same = all(
             np.asarray(a).tobytes() == np.asarray(b).tobytes()
             for a, b in zip(

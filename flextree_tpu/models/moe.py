@@ -46,6 +46,7 @@ from .transformer import (
     TransformerConfig,
     _dense_init,
     attention_block,
+    final_logits,
     global_positions,
     mlp_block,
     rms_norm,
@@ -298,7 +299,6 @@ def moe_forward(
             n_moe += 1
         else:
             x = mlp_block(layer, x, cfg, tp_axis=tp_axis)
-    x = rms_norm(x, params["ln_f"])
-    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    logits = final_logits(params["embed"], params["ln_f"], x)
     aux_mean = aux_total / max(n_moe, 1)
     return logits, aux_mean

@@ -52,6 +52,7 @@ __all__ = [
     "layer_forward",
     "attention_block",
     "mlp_block",
+    "final_logits",
     "global_positions",
     "cross_entropy_loss",
 ]
@@ -267,6 +268,16 @@ def mlp_block(layer, x, cfg: TransformerConfig, *, tp_axis: str | None = None):
     return x + _tp_combine(y, tp_axis, cfg)
 
 
+def final_logits(embed, ln_f, h):
+    """The LM head: final RMSNorm + tied-embedding projection to f32
+    logits.  The ONE definition shared by :func:`forward`,
+    ``moe.moe_forward``, and the overlap engines' per-segment head
+    (``parallel.overlap``) — the overlap path's bitwise contract depends
+    on these never drifting apart."""
+    x = rms_norm(h, ln_f)
+    return x.astype(jnp.float32) @ embed.T.astype(jnp.float32)
+
+
 def global_positions(t_local: int, sp_axis: str | None):
     """(T_local,) global positions for this device's sequence shard."""
     offset = lax.axis_index(sp_axis) * t_local if sp_axis is not None else 0
@@ -295,9 +306,7 @@ def forward(
         x = layer_forward(
             layer, x, positions, cfg, tp_axis=tp_axis, sp_axis=sp_axis
         )
-    x = rms_norm(x, params["ln_f"])
-    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    return logits
+    return final_logits(params["embed"], params["ln_f"], x)
 
 
 def cross_entropy_loss(logits, targets):

@@ -75,12 +75,18 @@ def make_moe_train_step(
     model_cfg: MoEConfig,
     train_cfg: TrainConfig = TrainConfig(),
     axis_names: tuple[str, str, str, str] = ("dp", "ep", "sp", "tp"),
+    serialize_overlap: bool = False,
 ):
     """Jitted ``(state, tokens, targets) -> (state, metrics)``.
 
     ``tokens``/``targets``: (B, T) int32, batch sharded over (dp, ep),
     sequence over sp.  ``metrics``: global mean ``loss`` (cross entropy),
     ``aux`` (router balance), and ``total`` (what is optimized).
+
+    ``train_cfg.overlap`` routes the backward through the readiness-
+    ordered segmented engine (``parallel.overlap``) — per-layer grads
+    fire their sync buckets as they are produced; ``serialize_overlap``
+    builds its barrier twin (see ``train.make_train_step``).
     """
     dp, ep, sp, tp = axis_names
     for a in axis_names:
@@ -115,27 +121,37 @@ def make_moe_train_step(
             * lax.axis_size(tp)
         )
 
-        def local_loss(params):
-            logits, aux = moe_forward(
-                params, tokens, model_cfg,
-                tp_axis=tp, sp_axis=sp, ep_axis=ep,
-            )
-            loss_sum, _ = cross_entropy_loss(logits, targets)
-            ce = loss_sum / n_total_tokens
-            # aux is a per-device mean; average it over every device (tp
-            # copies are redundant but identical, so the global mean is
-            # exact under the same 1/n_devices weighting)
-            aux_term = model_cfg.router_aux_weight * aux / n_devices
-            return ce + aux_term, (ce, aux)
-
-        (_, (ce, aux)), grads = jax.value_and_grad(local_loss, has_aux=True)(
-            state["params"]
-        )
-
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads, new_ef = sync_with_feedback(
-            state, grads, sspecs["params"], mesh_axes, topos, train_cfg
-        )
+        if train_cfg.overlap:
+            from .overlap import moe_overlap_step_grads
+
+            ce, aux, grads, new_ef = moe_overlap_step_grads(
+                state, tokens, targets, model_cfg, train_cfg,
+                sspecs["params"], mesh_axes, topos, n_total_tokens,
+                n_devices, tp_axis=tp, sp_axis=sp, ep_axis=ep,
+                serialize=serialize_overlap,
+            )
+        else:
+
+            def local_loss(params):
+                logits, aux = moe_forward(
+                    params, tokens, model_cfg,
+                    tp_axis=tp, sp_axis=sp, ep_axis=ep,
+                )
+                loss_sum, _ = cross_entropy_loss(logits, targets)
+                ce = loss_sum / n_total_tokens
+                # aux is a per-device mean; average it over every device
+                # (tp copies are redundant but identical, so the global
+                # mean is exact under the same 1/n_devices weighting)
+                aux_term = model_cfg.router_aux_weight * aux / n_devices
+                return ce + aux_term, (ce, aux)
+
+            (_, (ce, aux)), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(state["params"])
+            grads, new_ef = sync_with_feedback(
+                state, grads, sspecs["params"], mesh_axes, topos, train_cfg
+            )
 
         global_ce = ce
         global_aux = aux / n_devices

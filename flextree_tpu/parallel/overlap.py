@@ -1,0 +1,608 @@
+"""Readiness-ordered backward/comm overlap for the gradient sync.
+
+The serialized train step runs the whole backward, then pays the whole
+sync bill (``sync_with_feedback`` after ``jax.value_and_grad``).  But the
+data dependence is finer than that: the last layer's grads exist as soon
+as its backward segment runs, long before the first layer's.  This module
+decomposes the backward into per-layer segments (``jax.vjp`` per layer
+over the layer stack — the same chain rule ``value_and_grad`` runs,
+composed explicitly, so gradients are BITWISE identical) and fires each
+gradient bucket's FlexTree collective the moment its last segment's grads
+exist, in reverse layer order.  Each fired bucket is data-dependent only
+on its own segments' grads, so a scheduler with any concurrency (XLA's
+thunk executor, a TPU's async collectives) overlaps the wire time with
+the remaining backward compute instead of serializing after it.
+
+Readiness order for the ``{embed, ln_f, layers}`` model family:
+
+1. the loss head (``ln_f`` — its grad falls out of the logits backward),
+2. layers last-to-first (layer ``i``'s grads exist after its segment),
+3. the embedding — its grad is the sum of the logits-matmul contribution
+   (ready first) and the input-lookup contribution (ready LAST), so the
+   embed bucket always fires at backward end and its wire time is always
+   exposed.  Overlap shrinks exposure; it cannot zero it.
+
+Bucket *boundaries* are planner-driven
+(``planner.choose.choose_overlap_boundaries``): instead of minimizing
+sync time in isolation (``choose_bucket_bytes``), boundaries equalize
+each bucket's predicted comm time (α-β + codec terms) against the
+remaining backward compute below it — a bucket grows to amortize launch
+cost only while its wire time still fits under the compute left to hide
+it.
+
+The serialized twin (``serialize=True``) runs the IDENTICAL program with
+one change: a ``lax.optimization_barrier`` over every gradient before the
+first collective — the full-backward barrier the overlap removes.  Equal
+collective counts, equal inputs per collective, bitwise-equal outputs —
+the honest A/B comparator (and the ``overlap-serialization`` mutation
+class the HLO linter must catch).
+
+Error feedback composes: a lossy codec syncs ``grad + ef`` per fired
+bucket and returns the wire's input-quantization residual per leaf, with
+the exact same wire dtype and residual semantics as the serialized path
+(``train.sync_with_feedback``) — the twin comparison stays bitwise even
+for int8, because both paths quantize identical bucket payloads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..schedule.stages import Topology
+from ..utils.profiling import comm_span
+
+__all__ = [
+    "OverlapPlan",
+    "resolve_bwd_GFLOPs",
+    "readiness_segments",
+    "segment_flops",
+    "plan_overlap",
+    "dense_overlap_step_grads",
+    "moe_overlap_step_grads",
+    "overlap_sync_with_feedback",
+]
+
+#: Backend-resolved defaults for ``TpuCostParams.bwd_GFLOPs`` when the
+#: calibration leaves it at 0.0: a CPU host sustains single-digit GFLOP/s
+#: on f32 matmuls; an accelerator is TFLOP/s-scale (v5e bf16 peak 197,
+#: derated to achievable f32 backward throughput).
+_BWD_GFLOPS_DEFAULTS = {"cpu": 8.0}
+_BWD_GFLOPS_ACCEL = 49_000.0
+
+
+def resolve_bwd_GFLOPs(params) -> float:
+    """The boundary equalizer's compute throughput: the calibrated
+    ``bwd_GFLOPs`` when set, else a per-backend default (same resolution
+    pattern as ``bucketing._default_max_bucket_bytes``)."""
+    if params is not None and getattr(params, "bwd_GFLOPs", 0.0) > 0.0:
+        return params.bwd_GFLOPs
+    try:
+        backend = jax.default_backend()
+    except Exception:  # no backend initialized (pure planning)
+        backend = "cpu"
+    return _BWD_GFLOPS_DEFAULTS.get(backend, _BWD_GFLOPS_ACCEL)
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapPlan:
+    """Host-level overlap schedule: which readiness segments fire
+    together, plus the model's prediction for the honesty ledger."""
+
+    labels: tuple[str, ...]  # per segment, readiness order
+    boundaries: tuple[tuple[int, ...], ...]  # groups of segment indices
+    seg_bytes: tuple[int, ...]
+    seg_compute_us: tuple[float, ...]
+    predicted_total_us: float
+    predicted_exposed_us: float
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.boundaries)
+
+
+def readiness_segments(params) -> list[tuple[str, Any]]:
+    """(label, subtree-path) per backward segment in readiness order for
+    the ``{embed, ln_f, layers: [...]}`` model family.  The subtree-path
+    is ``("ln_f",)``, ``("layers", i)`` or ``("embed",)`` — usable against
+    the params tree, the grads tree, and the pspecs tree alike."""
+    n_layers = len(params["layers"])
+    segs: list[tuple[str, Any]] = [("head", ("ln_f",))]
+    for i in reversed(range(n_layers)):
+        segs.append((f"layer{i}", ("layers", i)))
+    segs.append(("embed", ("embed",)))
+    return segs
+
+
+def _subtree(tree, path):
+    out = tree
+    for p in path:
+        out = out[p]
+    return out
+
+
+def segment_flops(path, params_shapes, n_tokens: int, d_model: int,
+                  t_local: int) -> float:
+    """Estimated backward FLOPs of one readiness segment — matmul grads
+    (dgrad + wgrad ≈ 2x the forward's ``2·P·T``) over the segment's >=2-D
+    weight leaves, plus the attention score/value matmuls for layer
+    segments (``4·T²·d`` forward, doubled for backward).  An estimate, not
+    an oracle: boundary choice degrades gracefully under scale error (a
+    mispriced segment shifts one boundary by one layer), and the scale
+    constant is calibratable (``TpuCostParams.bwd_GFLOPs``)."""
+    sub = _subtree(params_shapes, path)
+    weight_params = sum(
+        math.prod(l.shape)
+        for l in jax.tree.leaves(sub)
+        if len(l.shape) >= 2
+    )
+    flops = 4.0 * weight_params * n_tokens
+    if path[0] == "layers":
+        flops += 8.0 * t_local * t_local * d_model * (n_tokens / t_local)
+    if path == ("ln_f",):
+        # the head segment's backward is the vocab-projection (logits)
+        # matmul grads — its own leaf (the 1-D norm scale) carries no
+        # matmul FLOPs, but d_logits flows through embed.T here, and for
+        # a realistic vocab this dominates the segment
+        v, d = params_shapes["embed"].shape
+        flops += 4.0 * v * d * n_tokens
+    if path[0] == "embed":
+        # input-lookup backward is a scatter-add, not a matmul (the
+        # logits contribution is charged to the head segment above)
+        flops = 2.0 * d_model * n_tokens
+    return flops
+
+
+def _cost_topologies(mesh_axes, topos, axis_sizes) -> list:
+    """Topologies the boundary chooser prices a fired bucket with: one per
+    mesh axis of size > 1, the ``"psum"`` sentinel costed as a flat tree
+    (same resolution as ``bucketing._derived_bucket_bytes``).  Priced for
+    the fully-replicated leaf group — the dominant-bytes group; tp-sharded
+    leaves sync over a subset of these axes, which the model treats as an
+    approximation, not a contract."""
+    out = []
+    for ax in mesh_axes:
+        n = int(axis_sizes.get(ax, 1))
+        if n <= 1:
+            continue
+        topo = topos.get(ax)
+        out.append(
+            Topology.flat(n) if topo is None else Topology.resolve(n, topo)
+        )
+    return out
+
+
+def plan_overlap(
+    params_shapes,
+    pspecs,
+    mesh_axes,
+    topos,
+    axis_sizes,
+    n_tokens: int,
+    t_local: int,
+    d_model: int,
+    cost_params=None,
+    codec=None,
+) -> OverlapPlan:
+    """Choose compute-equalized bucket boundaries for the readiness
+    segments of ``params_shapes`` (host-level; runs at trace time on
+    static shapes only)."""
+    from ..planner.choose import choose_overlap_boundaries, predict_overlap_schedule
+
+    if cost_params is None:
+        from ..planner.calibrate import default_params
+
+        cost_params = default_params()
+    gflops = resolve_bwd_GFLOPs(cost_params)
+    segs = readiness_segments(params_shapes)
+    labels, seg_bytes, seg_us = [], [], []
+    for label, path in segs:
+        sub = _subtree(params_shapes, path)
+        nbytes = sum(
+            l.size * jnp.dtype(l.dtype).itemsize for l in jax.tree.leaves(sub)
+        )
+        labels.append(label)
+        seg_bytes.append(int(nbytes))
+        seg_us.append(
+            segment_flops(path, params_shapes, n_tokens, d_model, t_local)
+            / (gflops * 1e3)
+        )
+    cost_topos = _cost_topologies(mesh_axes, topos, axis_sizes)
+    if not cost_topos:  # single-device mesh: nothing to sync, one bucket
+        boundaries = (tuple(range(len(segs))),)
+        total = exposed = 0.0
+    else:
+        boundaries = choose_overlap_boundaries(
+            seg_bytes, seg_us, cost_topos, params=cost_params, codec=codec
+        )
+        total, exposed = predict_overlap_schedule(
+            boundaries, seg_bytes, seg_us, cost_topos,
+            params=cost_params, codec=codec,
+        )
+    return OverlapPlan(
+        tuple(labels), boundaries, tuple(seg_bytes), tuple(seg_us),
+        total, exposed,
+    )
+
+
+# ------------------------------------------------------------- bucket fire
+
+
+def _sync_fired_bucket(
+    bucket_tree, bucket_specs, mesh_axes, topos, train_cfg, step, ef_tree,
+    name: str,
+):
+    """Sync one fired bucket with the exact ``sync_with_feedback``
+    semantics: identity codec -> plain bitwise sync, residual None; lossy
+    codec -> sync ``grad + ef`` wire-compressed, return the per-leaf
+    input-quantization residual.  Inner granularity inside the fired
+    payload follows ``train_cfg.bucket_bytes`` exactly like the serial
+    path (None -> planner argmin under the backend cache cap, 0 ->
+    per-leaf oracle, >0 -> explicit cap): the boundary decides WHEN a
+    payload fires, the inner argmin its collective granularity —
+    measured on the bench host, a fired bucket synced as one monolithic
+    collective loses both the cache-locality win the serial path already
+    banked (``bucketing.CPU_MAX_BUCKET_BYTES``) and the fine-grained
+    interleaving the scheduler needs to hide wire time under compute."""
+    from .train import _sync_codec, sync_grads
+
+    codec = _sync_codec(train_cfg)
+    with comm_span(name):
+        if not codec.lossy:
+            return (
+                sync_grads(
+                    bucket_tree, bucket_specs, mesh_axes, topos,
+                    bucket_bytes=train_cfg.bucket_bytes,
+                    chunks=train_cfg.grad_chunks,
+                ),
+                None,
+            )
+        v = jax.tree.map(
+            lambda g, e: g + e.astype(g.dtype), bucket_tree, ef_tree
+        )
+        return sync_grads(
+            v, bucket_specs, mesh_axes, topos,
+            bucket_bytes=train_cfg.bucket_bytes,
+            chunks=train_cfg.grad_chunks,
+            codec=codec, step=step, return_residual=True,
+        )
+
+
+def _fire_boundaries(
+    plan: OverlapPlan,
+    seg_paths,
+    seg_grads,
+    state,
+    pspecs,
+    mesh_axes,
+    topos,
+    train_cfg,
+    fire_at: dict[int, int],
+    seg_index: int,
+    synced_out: dict,
+    ef_out: dict,
+):
+    """Fire every bucket whose closing segment is ``seg_index``: merge its
+    segments into one tree, sync, scatter results back by path."""
+    bi = fire_at.get(seg_index)
+    if bi is None:
+        return
+    bucket = plan.boundaries[bi]
+    tree = {str(i): seg_grads[i] for i in bucket}
+    specs = {str(i): _subtree(pspecs, seg_paths[i]) for i in bucket}
+    ef = None
+    if "ef" in state:
+        ef = {str(i): _subtree(state["ef"], seg_paths[i]) for i in bucket}
+    nbytes = sum(plan.seg_bytes[i] for i in bucket)
+    name = f"ft_overlap_bucket{bi}_{plan.labels[bucket[0]]}_{nbytes}B"
+    synced, res = _sync_fired_bucket(
+        tree, specs, mesh_axes, topos, train_cfg, state["step"], ef, name
+    )
+    for i in bucket:
+        synced_out[i] = synced[str(i)]
+        if res is not None:
+            ef_out[i] = res[str(i)]
+
+
+def _assemble(params, seg_paths, synced_by_seg):
+    """Rebuild a full {embed, ln_f, layers} tree from per-segment parts."""
+    layers = [None] * len(params["layers"])
+    out = {"embed": None, "ln_f": None, "layers": layers}
+    for (path, sub) in zip(seg_paths, synced_by_seg):
+        if path[0] == "layers":
+            layers[path[1]] = sub
+        else:
+            out[path[0]] = sub
+    return out
+
+
+# --------------------------------------------------------------- engines
+
+
+def _run_overlap_engine(
+    state,
+    params,
+    pspecs,
+    mesh_axes,
+    topos,
+    train_cfg,
+    plan: OverlapPlan,
+    seg_paths,
+    backward_segments: Callable[[], Sequence],
+    serialize: bool,
+):
+    """Shared core of the dense/MoE engines: walk ``backward_segments()``
+    (a generator yielding each segment's raw grads in readiness order),
+    firing closed buckets as segments become ready — or, serialized, after
+    an ``optimization_barrier`` over every gradient (the full-backward
+    barrier; same buckets, same order, bitwise-equal results)."""
+    fire_at = {b[-1]: bi for bi, b in enumerate(plan.boundaries)}
+    n_seg = len(seg_paths)
+    seg_grads: list = [None] * n_seg
+    synced: dict[int, Any] = {}
+    ef_out: dict[int, Any] = {}
+
+    if serialize:
+        for i, g in enumerate(backward_segments()):
+            seg_grads[i] = g
+        # the overlap-serialization barrier: every collective below
+        # depends on the COMPLETE backward, exactly like the historical
+        # sync-after-value_and_grad step
+        seg_grads = list(lax.optimization_barrier(tuple(seg_grads)))
+        for i in range(n_seg):
+            _fire_boundaries(
+                plan, seg_paths, seg_grads, state, pspecs, mesh_axes, topos,
+                train_cfg, fire_at, i, synced, ef_out,
+            )
+    else:
+        for i, g in enumerate(backward_segments()):
+            seg_grads[i] = g
+            _fire_boundaries(
+                plan, seg_paths, seg_grads, state, pspecs, mesh_axes, topos,
+                train_cfg, fire_at, i, synced, ef_out,
+            )
+
+    grads = _assemble(params, seg_paths, [synced[i] for i in range(n_seg)])
+    new_ef = None
+    if ef_out:
+        new_ef = _assemble(params, seg_paths, [ef_out[i] for i in range(n_seg)])
+    return grads, new_ef
+
+
+def dense_overlap_step_grads(
+    state,
+    tokens,
+    targets,
+    model_cfg,
+    train_cfg,
+    pspecs,
+    mesh_axes,
+    topos,
+    n_total_tokens,
+    tp_axis,
+    sp_axis,
+    serialize: bool = False,
+):
+    """Loss + readiness-order-synced grads (+ EF residuals) for the dense
+    train step — the overlap twin of ``value_and_grad(local_loss)`` +
+    ``sync_with_feedback``, bitwise-identical for the identity codec.
+
+    Collective-context function (call inside ``shard_map``).  Returns
+    ``(loss, synced_grads, new_ef_or_None)``.
+    """
+    from ..models.transformer import (
+        cross_entropy_loss,
+        final_logits,
+        global_positions,
+        layer_forward,
+    )
+    from .train import _sync_codec
+
+    params = state["params"]
+    axis_sizes = {ax: lax.axis_size(ax) for ax in mesh_axes}
+    t_local = tokens.shape[1]
+    codec = _sync_codec(train_cfg)
+    plan = plan_overlap(
+        params, pspecs, mesh_axes, topos, axis_sizes,
+        n_tokens=tokens.size, t_local=t_local, d_model=model_cfg.d_model,
+        codec=codec if codec.lossy else None,
+    )
+    seg_paths = [path for _, path in readiness_segments(params)]
+    positions = global_positions(t_local, sp_axis)
+    n_layers = len(params["layers"])
+
+    # forward, holding one vjp per segment
+    x, vjp_embed = jax.vjp(
+        lambda e: e[tokens].astype(model_cfg.dtype), params["embed"]
+    )
+    layer_vjps = []
+    for layer in params["layers"]:
+        x, vjp_l = jax.vjp(
+            lambda l, h: layer_forward(
+                l, h, positions, model_cfg, tp_axis=tp_axis, sp_axis=sp_axis
+            ),
+            layer, x,
+        )
+        layer_vjps.append(vjp_l)
+
+    def head(embed, ln_f, h):
+        loss_sum, _ = cross_entropy_loss(final_logits(embed, ln_f, h), targets)
+        return loss_sum / n_total_tokens
+
+    loss, vjp_head = jax.vjp(head, params["embed"], params["ln_f"], x)
+
+    def backward_segments():
+        d_embed_head, d_ln_f, dx = vjp_head(jnp.float32(1.0))
+        yield d_ln_f  # segment 0: the loss head
+        for i in reversed(range(n_layers)):
+            d_layer, dx = layer_vjps[i](dx)
+            yield d_layer
+        (d_embed_in,) = vjp_embed(dx)
+        yield d_embed_head + d_embed_in  # last: embed closes at backward end
+
+    grads, new_ef = _run_overlap_engine(
+        state, params, pspecs, mesh_axes, topos, train_cfg, plan, seg_paths,
+        backward_segments, serialize,
+    )
+    return loss, grads, new_ef
+
+
+def moe_overlap_step_grads(
+    state,
+    tokens,
+    targets,
+    model_cfg,
+    train_cfg,
+    pspecs,
+    mesh_axes,
+    topos,
+    n_total_tokens,
+    n_devices,
+    tp_axis,
+    sp_axis,
+    ep_axis,
+    serialize: bool = False,
+):
+    """MoE twin of :func:`dense_overlap_step_grads`: per-layer segments
+    carry an auxiliary router-balance output whose cotangent is the
+    constant aux weight, so the composed vjp equals
+    ``value_and_grad(local_loss, has_aux=True)`` bitwise.
+
+    Returns ``(ce, aux_mean, grads, new_ef_or_None)``.
+    """
+    from ..models.moe import moe_layer
+    from ..models.transformer import (
+        attention_block,
+        cross_entropy_loss,
+        final_logits,
+        global_positions,
+        mlp_block,
+        rms_norm,
+    )
+    from .train import _sync_codec
+
+    params = state["params"]
+    axis_sizes = {ax: lax.axis_size(ax) for ax in mesh_axes}
+    t_local = tokens.shape[1]
+    codec = _sync_codec(train_cfg)
+    plan = plan_overlap(
+        params, pspecs, mesh_axes, topos, axis_sizes,
+        n_tokens=tokens.size, t_local=t_local, d_model=model_cfg.d_model,
+        codec=codec if codec.lossy else None,
+    )
+    seg_paths = [path for _, path in readiness_segments(params)]
+    positions = global_positions(t_local, sp_axis)
+    n_layers = len(params["layers"])
+    n_moe = sum(1 for i in range(n_layers) if model_cfg.is_moe_layer(i))
+
+    def apply_layer(i, layer, h):
+        h = attention_block(
+            layer, h, positions, model_cfg, tp_axis=tp_axis, sp_axis=sp_axis
+        )
+        if model_cfg.is_moe_layer(i):
+            hh = rms_norm(h, layer["ln2"])
+            y, aux = moe_layer(
+                layer, hh, model_cfg, tp_axis=tp_axis, ep_axis=ep_axis
+            )
+            return h + y, aux
+        return mlp_block(layer, h, model_cfg, tp_axis=tp_axis), jnp.float32(0.0)
+
+    x, vjp_embed = jax.vjp(
+        lambda e: e[tokens].astype(model_cfg.dtype), params["embed"]
+    )
+    layer_vjps = []
+    aux_vals = []
+    for i, layer in enumerate(params["layers"]):
+        (x, aux_i), vjp_l = jax.vjp(
+            lambda l, h, i=i: apply_layer(i, l, h), layer, x
+        )
+        layer_vjps.append(vjp_l)
+        aux_vals.append(aux_i)
+
+    def head(embed, ln_f, h):
+        loss_sum, _ = cross_entropy_loss(final_logits(embed, ln_f, h), targets)
+        return loss_sum / n_total_tokens
+
+    ce, vjp_head = jax.vjp(head, params["embed"], params["ln_f"], x)
+    aux_total = jnp.float32(0.0)
+    for a in aux_vals:
+        aux_total = aux_total + a
+    aux_mean = aux_total / max(n_moe, 1)
+    # d(total_loss)/d(aux_i): the aux enters the optimized loss as
+    # router_aux_weight * (sum(aux_i)/n_moe) / n_devices — a constant
+    # cotangent per layer (moe_train.make_moe_train_step's local_loss)
+    d_aux = jnp.float32(
+        model_cfg.router_aux_weight / (max(n_moe, 1) * n_devices)
+    )
+
+    def backward_segments():
+        d_embed_head, d_ln_f, dx = vjp_head(jnp.float32(1.0))
+        yield d_ln_f
+        for i in reversed(range(n_layers)):
+            d_layer, dx = layer_vjps[i]((dx, d_aux))
+            yield d_layer
+        (d_embed_in,) = vjp_embed(dx)
+        yield d_embed_head + d_embed_in
+
+    grads, new_ef = _run_overlap_engine(
+        state, params, pspecs, mesh_axes, topos, train_cfg, plan, seg_paths,
+        backward_segments, serialize,
+    )
+    return ce, aux_mean, grads, new_ef
+
+
+# ------------------------------------------------- whole-tree (pipeline)
+
+
+def overlap_sync_with_feedback(
+    state, grads, pspecs, mesh_axes, topos, train_cfg, serialize: bool = False
+):
+    """Post-backward readiness-ordered sync of a WHOLE gradient tree — the
+    pipeline step's overlap path.
+
+    SPMD GPipe's tick loop is a ``lax.scan``, and the scan transpose
+    emits every parameter gradient from one fused op: a true dataflow
+    barrier that readiness ordering cannot reach inside (that would take
+    MPMD per-stage programs).  What overlap CAN do there is schedule the
+    bucket collectives into the post-backward bubble — fired per
+    readiness bucket (head, layers, embed), each data-dependent only on
+    its own leaves, so the scheduler may run them under the loss psum /
+    metrics / optimizer tail instead of serializing before it.  Semantics
+    (and EF accounting) are exactly ``train.sync_with_feedback``'s;
+    ``serialize=True`` adds the same optimization_barrier twin as the
+    dense engine, for the A/B and the mutation class.
+    """
+    seg_paths = []
+    seg_grads = []
+    # readiness buckets at whole-tree granularity: head norm, the layer
+    # stack, then embed (the order the dense backward would free them)
+    for key in ("ln_f", "layers", "embed"):
+        seg_paths.append((key,))
+        seg_grads.append(grads[key])
+    if serialize:
+        seg_grads = list(lax.optimization_barrier(tuple(seg_grads)))
+    synced_parts = {}
+    ef_parts = {}
+    any_ef = False
+    for path, sub in zip(seg_paths, seg_grads):
+        specs = _subtree(pspecs, path)
+        ef = _subtree(state["ef"], path) if "ef" in state else None
+        nbytes = sum(
+            l.size * jnp.dtype(l.dtype).itemsize for l in jax.tree.leaves(sub)
+        )
+        synced, res = _sync_fired_bucket(
+            sub, specs, mesh_axes, topos, train_cfg, state["step"], ef,
+            f"ft_overlap_tail_{path[0]}_{nbytes}B",
+        )
+        synced_parts[path[0]] = synced
+        if res is not None:
+            ef_parts[path[0]] = res
+            any_ef = True
+    out = {k: synced_parts[k] for k in grads}
+    new_ef = {k: ef_parts[k] for k in grads} if any_ef else None
+    return out, new_ef

@@ -41,11 +41,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.transformer import (
     TransformerConfig,
     cross_entropy_loss,
+    final_logits,
     global_positions,
     init_params,
     layer_forward,
     param_specs,
-    rms_norm,
 )
 from .train import (
     TrainConfig,
@@ -186,8 +186,7 @@ def _pipeline_loss_sum(
         return x
 
     def final_loss(y, tgt_mb):
-        h = rms_norm(y, params["ln_f"])
-        logits = h.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+        logits = final_logits(params["embed"], params["ln_f"], y)
         loss_sum, _ = cross_entropy_loss(logits, tgt_mb)
         return loss_sum
 
@@ -225,6 +224,7 @@ def make_pipeline_train_step(
     train_cfg: TrainConfig = TrainConfig(),
     n_microbatches: int = 2,
     axis_names: tuple[str, str, str, str] = ("dp", "pp", "sp", "tp"),
+    serialize_overlap: bool = False,
 ):
     """Jitted 4-axis train step ``(state, tokens, targets) -> (state,
     metrics)`` with GPipe pipeline parallelism over ``axis_names[1]``.
@@ -232,6 +232,17 @@ def make_pipeline_train_step(
     ``state`` uses the stacked layout (``init_pipeline_train_state``);
     ``tokens``/``targets`` are (B, T) int32, batch over dp, sequence over
     sp; the per-device batch must be divisible by ``n_microbatches``.
+
+    ``train_cfg.overlap``: the backward of the GPipe tick loop is a
+    ``lax.scan`` transpose — one fused op emitting every gradient at
+    once, a dataflow barrier readiness ordering cannot reach inside (that
+    would take MPMD per-stage programs).  The overlap path therefore
+    schedules the sync collectives into the post-backward bubble: fired
+    per readiness bucket (head / layer stack / embed), each
+    data-dependent only on its own leaves, overlappable with the loss
+    psum, metrics and optimizer tail (``overlap.overlap_sync_with_
+    feedback``; docs/OVERLAP.md states the honest limit).
+    ``serialize_overlap`` builds its barrier twin.
     """
     dp, pp, sp, tp = axis_names
     for a in axis_names:
@@ -282,9 +293,17 @@ def make_pipeline_train_step(
         loss, grads = jax.value_and_grad(local_loss)(state["params"])
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads, new_ef = sync_with_feedback(
-            state, grads, sspecs["params"], mesh_axes, topos, train_cfg
-        )
+        if train_cfg.overlap:
+            from .overlap import overlap_sync_with_feedback
+
+            grads, new_ef = overlap_sync_with_feedback(
+                state, grads, sspecs["params"], mesh_axes, topos, train_cfg,
+                serialize=serialize_overlap,
+            )
+        else:
+            grads, new_ef = sync_with_feedback(
+                state, grads, sspecs["params"], mesh_axes, topos, train_cfg
+            )
         global_loss = loss
         for ax in mesh_axes:
             global_loss = lax.psum(global_loss, ax)

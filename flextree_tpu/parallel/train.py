@@ -124,6 +124,18 @@ class TrainConfig:
     # backend (cached under FLEXTREE_PLAN_CACHE — the second build is a
     # pure cache hit) instead of trusting the cost-model argmin.
     autotune: bool = False
+    # readiness-ordered backward/comm overlap (parallel/overlap.py): the
+    # dense/MoE steps decompose the backward per layer and fire each
+    # gradient bucket's collective as soon as its grads exist (reverse
+    # layer order), with bucket boundaries chosen by the planner to
+    # equalize per-bucket comm time against the remaining backward
+    # compute (planner.choose.choose_overlap_boundaries); the pipeline
+    # step schedules its bucket collectives into the post-backward bubble
+    # (the scan transpose is a dataflow barrier — docs/OVERLAP.md).
+    # Bitwise-identical to the serialized sync for the identity codec;
+    # EF/codec semantics carried through unchanged.  False (default) is
+    # the historical serialized path, byte-for-byte.
+    overlap: bool = False
 
 
 def prime_factors(n: int) -> list[int]:
@@ -414,7 +426,7 @@ def maybe_autotune_grad_topo(
             continue
         plan = autotune_plan(
             n, nbytes, dtype="float32", codecs=(train_cfg.codec,), top_k=3,
-            repeat=3,
+            repeat=3, overlap=train_cfg.overlap,
         )
         spec[ax] = plan.to_ft_topo()
     return dataclasses.replace(train_cfg, grad_topo=spec, autotune=False)
@@ -539,12 +551,19 @@ def make_train_step(
     model_cfg: TransformerConfig,
     train_cfg: TrainConfig = TrainConfig(),
     axis_names: tuple[str, str, str] = ("dp", "sp", "tp"),
+    serialize_overlap: bool = False,
 ):
     """Build the jitted full train step ``(state, tokens, targets) ->
     (state, metrics)``.
 
     ``tokens``/``targets``: (B, T) int32, batch sharded over dp, sequence
     over sp.  ``metrics``: {'loss': global mean token loss}.
+
+    ``serialize_overlap`` (with ``train_cfg.overlap``) builds the
+    serialized TWIN of the overlapped step: the identical program with a
+    full-backward ``optimization_barrier`` before the first sync
+    collective — the bench/verifier comparator (equal collective counts,
+    bitwise-equal results) and the ``overlap-serialization`` mutant.
     """
     dp, sp, tp = axis_names
     for a in axis_names:
@@ -567,19 +586,28 @@ def make_train_step(
             * lax.axis_size(tp)  # tp-fold redundancy, see module docstring
         )
 
-        def local_loss(params):
-            logits = forward(
-                params, tokens, model_cfg, tp_axis=tp, sp_axis=sp
-            )
-            loss_sum, _ = cross_entropy_loss(logits, targets)
-            return loss_sum / n_total_tokens
-
-        loss, grads = jax.value_and_grad(local_loss)(state["params"])
-
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
-        grads, new_ef = sync_with_feedback(
-            state, grads, sspecs["params"], mesh_axes, topos, train_cfg
-        )
+        if train_cfg.overlap:
+            from .overlap import dense_overlap_step_grads
+
+            loss, grads, new_ef = dense_overlap_step_grads(
+                state, tokens, targets, model_cfg, train_cfg,
+                sspecs["params"], mesh_axes, topos, n_total_tokens,
+                tp_axis=tp, sp_axis=sp, serialize=serialize_overlap,
+            )
+        else:
+
+            def local_loss(params):
+                logits = forward(
+                    params, tokens, model_cfg, tp_axis=tp, sp_axis=sp
+                )
+                loss_sum, _ = cross_entropy_loss(logits, targets)
+                return loss_sum / n_total_tokens
+
+            loss, grads = jax.value_and_grad(local_loss)(state["params"])
+            grads, new_ef = sync_with_feedback(
+                state, grads, sspecs["params"], mesh_axes, topos, train_cfg
+            )
         global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
 
         metrics = {"loss": global_loss}

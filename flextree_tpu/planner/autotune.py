@@ -209,6 +209,7 @@ def autotune_plan(
     timer=None,
     repeat: int = 5,
     use_cache: bool = True,
+    overlap: bool = False,
 ) -> TunedPlan:
     """Pick the gradient-sync plan by measurement.
 
@@ -221,11 +222,21 @@ def autotune_plan(
     ``codecs=("f32",)`` tunes shape only (the measured twin of
     ``choose_topology``); the default product also offers the wire codecs
     so the planner can trade shape against precision.
+
+    ``overlap`` tags the cache key: a plan measured for the serialized
+    sync must never be silently replayed for the readiness-ordered
+    overlapped sync (or vice versa) — the overlapped step issues its
+    collectives mid-backward, where the best shape can differ (smaller
+    latency-bound buckets win when comm hides under compute).  The
+    shortlist and measurement protocol are shared; only the key differs.
     """
     codecs = tuple(codecs)
     shortlist = analytic_shortlist(n, nbytes, codecs, params=params, top_k=top_k)
     fp = backend_fingerprint()
-    key = plan_cache_key(fp, f"n{n}", f"{nbytes}B", dtype, ",".join(codecs))
+    key = plan_cache_key(
+        fp, f"n{n}", f"{nbytes}B", dtype, ",".join(codecs),
+        "overlap" if overlap else "serial",
+    )
     path = _cache_path(cache_path)
 
     if use_cache and path:

@@ -276,6 +276,7 @@ def _params_to_dict(p: TpuCostParams) -> dict:
         "control_us_per_width": p.control_us_per_width,
         "launch_us": p.launch_us,
         "codec_bw_GBps": p.codec_bw_GBps,
+        "bwd_GFLOPs": p.bwd_GFLOPs,
     }
 
 
@@ -288,6 +289,9 @@ def _params_from_dict(d: dict) -> TpuCostParams:
         launch_us=d["launch_us"],
         # schema-1 files predate the codec term: fall back to the default
         codec_bw_GBps=d.get("codec_bw_GBps", TpuCostParams.codec_bw_GBps),
+        # files written before the overlap planner lack the backward-compute
+        # constant: 0.0 keeps the backend-resolved default in force
+        bwd_GFLOPs=d.get("bwd_GFLOPs", TpuCostParams.bwd_GFLOPs),
     )
 
 

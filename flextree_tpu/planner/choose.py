@@ -43,6 +43,10 @@ __all__ = [
     "choose_topology",
     "candidate_topologies",
     "choose_bucket_bytes",
+    "choose_overlap_boundaries",
+    "predict_overlap_schedule",
+    "overlap_comm_us",
+    "WIRE_PESSIMISM_BAND",
     "replan_for_survivors",
 ]
 
@@ -338,6 +342,199 @@ def choose_bucket_bytes(
         if t_k < best_t:
             best_k, best_t = k, t_k
     return -(-nbytes // best_k)  # ceil
+
+
+#: Wire pessimism band for the overlap boundary argmin: candidate
+#: partitions are scored by the sum of predicted makespans with comm
+#: scaled by each factor.  1x is the calibrated capability estimate; the
+#: inflated points model in-step contention (collectives share memory
+#: bandwidth and cores with the backward), which hurts late-firing plans
+#: far more than early-firing ones.
+WIRE_PESSIMISM_BAND = (1.0, 2.0, 4.0)
+
+
+def overlap_comm_us(
+    nbytes: int,
+    topos,
+    params: TpuCostParams | None = None,
+    codec=None,
+) -> float:
+    """Predicted wall time (µs) of ONE fired overlap bucket of ``nbytes``:
+    one allreduce sequence per replication-axis topology in ``topos``
+    (launch + wire + reduce + codec terms, summed across axes) — the unit
+    the boundary chooser's wire-serial schedule model is built from."""
+    if params is None:
+        from .calibrate import default_params
+
+        params = default_params()
+    topo_list = (
+        [topos] if isinstance(topos, (Topology, LonelyTopology)) else list(topos)
+    )
+    total = 0.0
+    for t in topo_list:
+        if isinstance(t, LonelyTopology):
+            total += lonely_allreduce_cost(
+                t.tree, t.lonely, nbytes, params, codec=codec
+            ).total_us
+        else:
+            total += allreduce_cost(t, nbytes, params, codec=codec).total_us
+    return total
+
+
+def predict_overlap_schedule(
+    boundaries,
+    seg_bytes,
+    seg_compute_us,
+    topos,
+    params: TpuCostParams | None = None,
+    codec=None,
+) -> tuple[float, float]:
+    """(total_us, exposed_us) of a readiness-ordered overlap schedule.
+
+    Model: backward segments run in readiness order (segment ``i`` of
+    ``seg_compute_us`` finishes at ``cum[i]``); a bucket — a group of
+    consecutive segment indices in ``boundaries`` — is *issued* when its
+    last segment's grads exist, and the wire is serial: a bucket's
+    collective starts at ``max(issue_time, wire_free)`` and holds the wire
+    for its :func:`overlap_comm_us`.  ``total`` is when the last collective
+    drains; ``exposed = total - total_backward_compute`` is the sync time
+    NOT hidden behind remaining backward compute — the quantity the
+    train-step bench measures as the step-time delta over a sync-free
+    step.  The last bucket always issues at backward end, so its comm is
+    always exposed: overlap shrinks exposure, never to zero.
+    """
+    if params is None:
+        from .calibrate import default_params
+
+        params = default_params()
+    cum = [0.0]
+    for c in seg_compute_us:
+        cum.append(cum[-1] + float(c))
+    wire_free = 0.0
+    for bucket in boundaries:
+        nbytes = sum(seg_bytes[i] for i in bucket)
+        issue = cum[bucket[-1] + 1]
+        start = max(issue, wire_free)
+        wire_free = start + overlap_comm_us(nbytes, topos, params, codec)
+    total = max(cum[-1], wire_free)
+    return total, total - cum[-1]
+
+
+def choose_overlap_boundaries(
+    seg_bytes,
+    seg_compute_us,
+    topos,
+    *,
+    params: TpuCostParams | None = None,
+    codec=None,
+    max_enum_segments: int = 12,
+) -> tuple[tuple[int, ...], ...]:
+    """Compute-equalized bucket boundaries for readiness-ordered overlap.
+
+    ``seg_bytes[i]`` / ``seg_compute_us[i]`` describe backward segment
+    ``i`` in READINESS order (loss head first, then layers last-to-first,
+    then the embedding, whose grad completes only at backward end).  The
+    returned boundaries partition ``range(len(seg_bytes))`` into
+    consecutive groups; each group syncs as one fired bucket (one
+    allreduce sequence per replication axis).
+
+    This is NOT ``choose_bucket_bytes``'s sync-time argmin: a bucket here
+    trades the launch amortization of growing against the *hiding budget*
+    of closing early — a bucket that closes after segment ``j`` can hide
+    its wire time under the backward compute of segments ``j+1..``, so the
+    chooser equalizes each bucket's predicted comm against the remaining
+    compute below it by minimizing the :func:`predict_overlap_schedule`
+    makespan.  Robustness to wire-model error: the calibrated wire
+    constants are a capability estimate, and IN-STEP comm is slower
+    (collectives contend with the backward for memory bandwidth and
+    cores) — an error that punishes asymmetrically, because an
+    underestimated wire makes a late-firing plan queue its whole tail
+    past backward end while an early-firing plan just hides less.  The
+    argmin therefore scores each candidate partition by the SUM of its
+    predicted makespans under a pessimism band (comm scaled by
+    :data:`WIRE_PESSIMISM_BAND`), which biases near-ties toward earlier
+    firing; ties break toward fewer buckets (launch amortization).  Up
+    to ``max_enum_segments`` segments every contiguous partition is
+    enumerated exactly (span comm costs memoized, so this is a few
+    thousand table lookups); beyond that a greedy pass closes a bucket as
+    soon as extending it would push its comm past the remaining-compute
+    hiding budget.
+    """
+    if params is None:
+        from .calibrate import default_params
+
+        params = default_params()
+    s = len(seg_bytes)
+    if s == 0:
+        return ()
+    if len(seg_compute_us) != s:
+        raise ValueError(
+            f"seg_bytes has {s} segments, seg_compute_us {len(seg_compute_us)}"
+        )
+    if s == 1:
+        return ((0,),)
+
+    # memoize comm cost per contiguous span [i, j]
+    span_us: dict[tuple[int, int], float] = {}
+    for i in range(s):
+        nbytes = 0
+        for j in range(i, s):
+            nbytes += seg_bytes[j]
+            span_us[(i, j)] = overlap_comm_us(nbytes, topos, params, codec)
+
+    cum = [0.0]
+    for c in seg_compute_us:
+        cum.append(cum[-1] + float(c))
+
+    def simulate(bounds, scale: float = 1.0) -> tuple[float, float]:
+        wire_free = 0.0
+        for i, j in bounds:
+            start = max(cum[j + 1], wire_free)
+            wire_free = start + scale * span_us[(i, j)]
+        total = max(cum[-1], wire_free)
+        return total, total - cum[-1]
+
+    if s <= max_enum_segments:
+        best = None
+        # a partition of s segments = a subset of the s-1 interior cuts
+        for mask in range(1 << (s - 1)):
+            bounds = []
+            start = 0
+            for cut in range(s - 1):
+                if mask >> cut & 1:
+                    bounds.append((start, cut))
+                    start = cut + 1
+            bounds.append((start, s - 1))
+            score = sum(
+                simulate(bounds, scale)[0] for scale in WIRE_PESSIMISM_BAND
+            )
+            key = (score, len(bounds))
+            if best is None or key < best[0]:
+                best = (key, bounds)
+        bounds = best[1]
+    else:
+        # greedy fallback (> max_enum_segments): close a bucket as soon
+        # as it has amortized its fixed launch cost — early firing is the
+        # robust default (see the pessimism rationale above) and a bucket
+        # only grows while launches still dominate its wire time.  Two
+        # boundary conditions mirror the exhaustive path's limits: while
+        # hiding budget remains (compute left below the close), fire
+        # amortized buckets eagerly; once none remains (the unhideable
+        # tail) stop splitting entirely — every further cut would add a
+        # fully-exposed launch for nothing.
+        fixed_us = overlap_comm_us(0, topos, params, codec)
+        bounds = []
+        start = 0
+        for j in range(s - 1):
+            remaining_after_next = cum[-1] - cum[j + 2]
+            if (
+                remaining_after_next > 0
+                and span_us[(start, j)] >= 4.0 * fixed_us
+            ):
+                bounds.append((start, j))
+                start = j + 1
+        bounds.append((start, s - 1))
+    return tuple(tuple(range(i, j + 1)) for i, j in bounds)
 
 
 def replan_for_survivors(

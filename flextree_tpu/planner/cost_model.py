@@ -79,6 +79,14 @@ class TpuCostParams:
     # per backend (planner/calibrate.py fits it alongside the others when
     # compressed measurement points are provided)
     codec_bw_GBps: float = 200.0
+    # achievable dense-matmul throughput (GFLOP/s) for the backward-compute
+    # estimate the overlap boundary equalizer uses
+    # (planner.choose.choose_overlap_boundaries): comm can only hide under
+    # compute, so the equalizer needs an absolute compute scale, not just
+    # wire terms.  0.0 (the default) = resolve per backend at use time
+    # (parallel/overlap.py: a CPU host is GFLOP/s-scale, an accelerator
+    # TFLOP/s-scale); calibratable like every other constant.
+    bwd_GFLOPs: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -37,6 +37,7 @@ def build(args):
         min_lr_frac=args.min_lr_frac,
         codec=args.codec,
         autotune=args.autotune,
+        overlap=args.overlap,
     )
     key = jax.random.PRNGKey(args.seed)
     mesh_shape = (
@@ -163,7 +164,17 @@ def main(argv=None) -> int:
         help="pick the gradient-sync topology by measuring the analytic "
         "top-K candidates on this backend (planner/autotune.py) instead "
         "of trusting the cost-model argmin; cached under "
-        "FLEXTREE_PLAN_CACHE so the next run is a pure cache hit",
+        "FLEXTREE_PLAN_CACHE so the next run is a pure cache hit "
+        "(overlapped and serialized plans never share a cache entry)",
+    )
+    ap.add_argument(
+        "--overlap", action=argparse.BooleanOptionalAction, default=False,
+        help="readiness-ordered backward/comm overlap (docs/OVERLAP.md): "
+        "fire each gradient bucket's collective as soon as its grads are "
+        "produced (reverse layer order), boundaries planner-equalized "
+        "against remaining backward compute; bitwise-identical to the "
+        "serialized sync for the f32 codec. --no-overlap (default) keeps "
+        "the historical serialized sync",
     )
     ap.add_argument("--mesh", type=str, default=None,
                     help="comma mesh shape, e.g. 2,2,2 (dense) or 1,2,2,2")

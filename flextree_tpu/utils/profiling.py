@@ -38,6 +38,9 @@ __all__ = [
     "phase_timer",
     "PhaseTimer",
     "comm_span",
+    "SpanLedger",
+    "span_ledger",
+    "exposed_split",
     "Ewma",
     "step_scope",
     "debug_dump_schedule",
@@ -94,6 +97,74 @@ def step_scope(ewma: "Ewma | None" = None, on_duration=None):
             on_duration(dt)
 
 
+class SpanLedger:
+    """Trace-time accounting of :func:`comm_span` scopes.
+
+    While active (``with span_ledger() as ledger``), every ``comm_span``
+    entered — including inside a ``jax.jit`` trace — records its name
+    into the ledger.  Bucket-sync span names carry their payload bytes as
+    a ``_{nbytes}B`` suffix (``ft_bucket*`` / ``ft_overlap_bucket*``), so
+    the ledger can attribute *planned wire bytes per bucket* for a traced
+    step: the bench's exposed-vs-hidden comm split uses this to assert
+    which buckets actually fired and what they carried, next to the
+    measured step-time delta (``exposed_split``).  Host-side bookkeeping
+    only — nothing enters the traced program.
+    """
+
+    def __init__(self):
+        self.spans: list[str] = []
+
+    def record(self, name: str) -> None:
+        self.spans.append(name)
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(self.spans)
+
+    def total_bytes(self, prefix: str = "") -> int:
+        """Sum of the ``_{n}B`` suffixes of recorded spans with ``prefix``."""
+        total = 0
+        for name in self.spans:
+            if not name.startswith(prefix):
+                continue
+            tail = name.rsplit("_", 1)[-1]
+            if tail.endswith("B") and tail[:-1].isdigit():
+                total += int(tail[:-1])
+        return total
+
+
+_ACTIVE_LEDGERS: list[SpanLedger] = []
+
+
+@contextlib.contextmanager
+def span_ledger():
+    """Collect every ``comm_span`` entered in this block into a
+    :class:`SpanLedger` (trace-time; reentrant — nested ledgers all
+    record)."""
+    ledger = SpanLedger()
+    _ACTIVE_LEDGERS.append(ledger)
+    try:
+        yield ledger
+    finally:
+        _ACTIVE_LEDGERS.remove(ledger)
+
+
+def exposed_split(step_ms: float, nosync_step_ms: float, comm_total_ms: float):
+    """(exposed_ms, hidden_ms) of a train step's comm time.
+
+    ``exposed`` is the step-time delta over the sync-free twin — the sync
+    time that extended the step.  ``hidden`` is the remainder of the
+    measured sync-only time (``comm_total_ms``, the ``comm_span``-scoped
+    collectives timed alone): wire time that ran under compute instead of
+    extending the step.  Clamped at zero both ways: on a noisy host the
+    deltas can cross zero, and a negative exposure means "fully hidden",
+    not negative time.
+    """
+    exposed = max(float(step_ms) - float(nosync_step_ms), 0.0)
+    hidden = max(float(comm_total_ms) - exposed, 0.0)
+    return exposed, hidden
+
+
 @contextlib.contextmanager
 def comm_span(name: str, timer: "PhaseTimer | None" = None):
     """Named communication span: a ``jax.named_scope`` (so the span shows up
@@ -112,6 +183,8 @@ def comm_span(name: str, timer: "PhaseTimer | None" = None):
     """
     import jax
 
+    for ledger in _ACTIVE_LEDGERS:
+        ledger.record(name)
     with jax.named_scope(name):
         yield
     if timer is not None:
