@@ -496,6 +496,82 @@ print("OVERLAP_JSON: " + json.dumps({{
 '''
 
 
+_SHARDED_TRIPWIRE_CODE = r'''
+import json, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from flextree_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(8)
+import numpy as np
+from flextree_tpu.analysis.hlo_lint import (
+    _lower_sharded_train_step, collective_wire_bytes,
+)
+from flextree_tpu.models.transformer import TransformerConfig
+from flextree_tpu.parallel.train import (
+    TrainConfig, init_train_state, make_mesh_nd, make_train_step,
+)
+
+# 1) f32 sharded step bitwise == replicated step on this exact tree
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64)
+mesh = make_mesh_nd(8, (2, 2, 2), ("dp", "sp", "tp"))
+tok = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 64)
+outs = {{}}
+for name, tc in (
+    ("rep", TrainConfig()),
+    ("sh", TrainConfig(shard_optimizer=True)),
+):
+    st = init_train_state(jax.random.PRNGKey(0), cfg, tc, mesh=mesh)
+    step = make_train_step(mesh, cfg, tc)
+    for _ in range(2):
+        st, m = step(st, tok, tok)
+    outs[name] = st["params"]
+violations = 0 if all(
+    np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    for a, b in zip(jax.tree.leaves(outs["rep"]), jax.tree.leaves(outs["sh"]))
+) else 1
+
+# 2) static wire-byte ratio: sharded-int8 step vs replicated fused f32,
+# both on the loop-free flat(8) plan (collective operand bytes from the
+# lowered StableHLO — same accounting as BENCH_SHARDED.json's floor)
+rep_ir = _lower_sharded_train_step(regather=True)  # = the replicated step
+sh_ir = _lower_sharded_train_step(codec="int8")
+ratio = (
+    collective_wire_bytes(sh_ir)["total"]
+    / max(collective_wire_bytes(rep_ir)["total"], 1)
+)
+print("SHARDED_JSON: " + json.dumps({{
+    "sharded_bitwise_violations": violations,
+    "sharded_wire_bytes_ratio": round(ratio, 3),
+}}))
+'''
+
+
+def run_sharded_tripwire(timeout_s: int = 420) -> dict:
+    """Supplementary keys ``sharded_bitwise_violations`` (ZeRO-1 f32
+    sharded step bitwise-equal to the replicated step on this exact tree;
+    0 = identical) and ``sharded_wire_bytes_ratio`` (static collective
+    operand bytes of the quantized sharded step over the replicated fused
+    f32 step's — the same accounting BENCH_SHARDED.json machine-checks
+    at <= 0.6 on the real 2-process wire).  Subprocess-guarded: absent
+    keys read as "not verified", never as "clean"."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _SHARDED_TRIPWIRE_CODE.format(repo=REPO)],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in p.stdout.splitlines():
+            if line.startswith("SHARDED_JSON: "):
+                return json.loads(line[len("SHARDED_JSON: "):])
+        return {
+            "sharded_error": f"no SHARDED_JSON (rc={p.returncode}); "
+            f"stderr tail: {p.stderr[-200:]}"
+        }
+    except (subprocess.SubprocessError, OSError, ValueError) as e:
+        return {"sharded_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def run_overlap_tripwire(timeout_s: int = 300) -> dict:
     """Supplementary keys ``overlap_bitwise_violations`` (the overlapped
     and barrier-serialized train steps' updated params bitwise-equal to
@@ -613,6 +689,7 @@ def main() -> int:
         result.update(run_runtime_report_tripwire())
         result.update(run_quantize_tripwire())
         result.update(run_overlap_tripwire())
+        result.update(run_sharded_tripwire())
     print(json.dumps(result))
     return 0
 

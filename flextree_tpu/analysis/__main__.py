@@ -38,7 +38,7 @@ def build_report(include_hlo: bool = True) -> dict:
     from .base import violations_to_json
     from .jit_hygiene import run_jit_hygiene
     from .mutation import run_mutation_selftest
-    from .schedule_check import check_standard_schedules
+    from .schedule_check import check_split_schedules, check_standard_schedules
 
     t0 = time.perf_counter()
     report: dict = {"layers": {}}
@@ -49,6 +49,15 @@ def build_report(include_hlo: bool = True) -> dict:
     report["layers"]["schedule_check"] = {
         "programs_checked": programs,
         "violations": len(sched_v),
+    }
+
+    # standalone reduce-scatter / all-gather programs (PR 7): conservation
+    # proves each rank ends with exactly its owned block / the full vector
+    split_v, split_programs = check_split_schedules()
+    violations += split_v
+    report["layers"]["split_schedule_check"] = {
+        "programs_checked": split_programs,
+        "violations": len(split_v),
     }
 
     if include_hlo:

@@ -36,9 +36,11 @@ __all__ = [
     "HloBudget",
     "collective_counts",
     "collective_operand_dtypes",
+    "collective_wire_bytes",
     "lint_ir",
     "lower_entrypoints",
     "overlap_sync_budget",
+    "sharded_sync_budget",
     "run_hlo_lint",
 ]
 
@@ -90,6 +92,17 @@ class HloBudget:
     #: so the check is a pure text-order one).  Only meaningful on
     #: entrypoints whose forward has no collectives (dp-only meshes).
     require_compute_after_collective: bool = False
+    #: sharded (ZeRO) entrypoints: at least one all_gather must FOLLOW the
+    #: first optimizer sqrt (AdamW's sqrt(nu)) in program order — the
+    #: sharded step gathers updated PARAMETERS, which exist only after the
+    #: shard update; a step whose gathers all precede the optimizer math
+    #: has regathered the GRADIENTS instead (the replicated schedule in
+    #: disguise: numerically identical for f32, but the optimizer state is
+    #: fully replicated again and the wire savings the sharding exists for
+    #: are gone).  Violation kind "shard-regather"; only meaningful on
+    #: entrypoints whose forward emits no all_gather (dp-only meshes) and
+    #: whose only sqrt is AdamW's (rms_norm uses rsqrt, a different op).
+    require_gather_after_update: bool = False
     note: str = ""
 
 
@@ -112,6 +125,68 @@ def collective_operand_dtypes(ir: str) -> dict[str, list[str]]:
             if m:
                 elem = m.group(1).split("x")[-1]
                 out[op].append(elem)
+    return out
+
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "i32": 4, "i16": 2, "i8": 1, "i1": 1,
+    "ui64": 8, "ui32": 4, "ui16": 2, "ui8": 1,
+}
+
+
+_COLL_RE = re.compile(
+    r'"stablehlo\.(reduce_scatter|all_reduce|all_gather|all_to_all|'
+    r'collective_permute)"'
+)
+_SIG_RE = re.compile(r":\s*\(([^()]*)\)\s*->")
+_GRP_RE = re.compile(r"replica_groups\s*=\s*dense<[^>]*>\s*:\s*tensor<\d+x(\d+)xi64>")
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*)([a-z][a-z0-9]*)>")
+
+
+def collective_wire_bytes(ir: str) -> dict[str, float]:
+    """Per-chip wire bytes of every collective in ``ir``, from the lowered
+    StableHLO — the static accounting BENCH_SHARDED.json's floor is
+    checked against.
+
+    Per op the operand bytes (every tensor in its ``: (...) ->``
+    signature; region ops close with ``}) : (tensor<..>)``, and their
+    reducer-body ops carry no parenthesized signature, so the first match
+    after the op IS its own) are scaled by the op's wire factor over its
+    replica-group width ``w``: ``(w-1)/w`` for reduce_scatter/all_to_all
+    (each chip keeps 1/w), ``2(w-1)/w`` for all_reduce, ``w-1`` for
+    all_gather (the operand is the 1/w tile; each chip receives ``w-1``
+    more), ``1`` for collective_permute.  Only valid for programs whose
+    collectives are not inside ``fori_loop`` bodies (loop trip counts are
+    invisible to a text scan) — the flat tree lowers loop-free, which is
+    why the sharded bench pins ``grad_topo`` flat.
+    """
+    out: dict[str, float] = {op: 0.0 for op in COLLECTIVE_OPS}
+    for m in _COLL_RE.finditer(ir):
+        op = m.group(1)
+        window = ir[m.start() : m.start() + 8000]
+        sig = _SIG_RE.search(window)
+        if not sig:
+            continue
+        grp = _GRP_RE.search(window[: sig.end()])
+        w = int(grp.group(1)) if grp else 1
+        nbytes = 0
+        for dims, ty in _TENSOR_RE.findall(sig.group(1)):
+            n = 1
+            for d in dims.split("x"):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DTYPE_BYTES.get(ty, 4)
+        if op in ("reduce_scatter", "all_to_all"):
+            factor = (w - 1) / w if w > 1 else 0.0
+        elif op == "all_reduce":
+            factor = 2 * (w - 1) / w if w > 1 else 0.0
+        elif op == "all_gather":
+            factor = float(w - 1)
+        else:
+            factor = 1.0
+        out[op] += nbytes * factor
+    out["total"] = sum(out[op] for op in COLLECTIVE_OPS)
     return out
 
 
@@ -196,6 +271,50 @@ def lint_ir(name: str, ir: str, budget: HloBudget) -> list[Violation]:
                     "sync collective: every collective trails the full "
                     "backward — the readiness-ordered overlap has been "
                     "serialized behind a full-backward barrier",
+                )
+            )
+    if budget.require_gather_after_update:
+        # anchor AFTER the first sync collective (reduce_scatter /
+        # all_to_all): the forward emits its own sqrt ops, but only the
+        # optimizer's sqrt(nu) can appear after the gradient sync starts
+        # — in the correct sharded step that sqrt precedes the parameter
+        # all_gather; in the grad-regathering corruption every gather
+        # lands before it
+        lines = ir.splitlines()
+        first_coll = None
+        first_sqrt_after = None
+        last_gather = None
+        for i, line in enumerate(lines):
+            if first_coll is None and (
+                '"stablehlo.reduce_scatter"' in line
+                or '"stablehlo.all_to_all"' in line
+            ):
+                first_coll = i
+            if (
+                first_coll is not None
+                and first_sqrt_after is None
+                and i > first_coll
+                and "stablehlo.sqrt " in line
+            ):
+                first_sqrt_after = i
+            if '"stablehlo.all_gather"' in line:
+                last_gather = i
+        if (
+            first_coll is None
+            or first_sqrt_after is None
+            or last_gather is None
+            or last_gather < first_sqrt_after
+        ):
+            out.append(
+                Violation(
+                    "hlo",
+                    "shard-regather",
+                    name,
+                    "no all_gather follows the optimizer update (first "
+                    "sqrt) in program order: the step gathers GRADIENTS "
+                    "instead of updated parameter shards — the replicated "
+                    "schedule in disguise, with the optimizer state fully "
+                    "replicated again and the sharded wire savings gone",
                 )
             )
     if budget.require_donation and "jax.buffer_donor" not in ir:
@@ -446,6 +565,102 @@ def overlap_sync_budget(codec: str = "f32") -> tuple[int, int]:
     return plan.n_buckets, len(plan.labels)
 
 
+def _lower_split_collective(topo, phase: str, codec: str = "f32") -> str:
+    """Lower a standalone reduce_scatter or all_gather over the 8-device
+    mesh (divisible count, so the shard is a pure 1/N block)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.allreduce import all_gather, reduce_scatter
+    from ..parallel.mesh import flat_mesh
+
+    mesh = flat_mesh(8, "ft")
+    size = 2048
+
+    def f(row):
+        if phase == "rs":
+            return reduce_scatter(row[0], "ft", topo, codec=codec)[None]
+        return all_gather(row[0], "ft", topo, codec=codec)[None]
+
+    n_in = size if phase == "rs" else size // 8
+    fn = jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"))
+    return jax.jit(fn).lower(jnp.zeros((8, n_in), jnp.float32)).as_text()
+
+
+def _lower_sharded_train_step(codec: str = "f32", regather: bool = False) -> str:
+    """Lower the ZeRO-1 sharded dense step on a dp-only 8-device mesh —
+    tp=sp=1, so the forward emits NO collectives and every reduce-scatter
+    / all_gather in the program belongs to the sharded sync (the
+    precondition for ``require_gather_after_update``).
+
+    ``regather=True`` builds the *corrupted* variant for the mutation
+    self-test: the replicated step over the same explicit flat(8) plan —
+    literally "a sharded step that secretly all-gathers gradients instead
+    of parameters" (identical collective counts: one rs + one ag per
+    bucket; bitwise-identical f32 numerics; the ONLY observable
+    difference is that its gathers precede the optimizer sqrt)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_nd,
+        make_train_step,
+    )
+
+    model_cfg = _small_model_cfg()
+    mesh = make_mesh_nd(8, (8, 1, 1), ("dp", "sp", "tp"))
+    train_cfg = TrainConfig(
+        shard_optimizer=not regather, codec=codec,
+        bucket_bytes=1 << 30, grad_topo="8",
+    )
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg, train_cfg, mesh=mesh),
+        jax.random.PRNGKey(0),
+    )
+    tok = jax.ShapeDtypeStruct((8, 32), jnp.int32)
+    step = make_train_step(mesh, model_cfg, train_cfg)
+    return step.lower(state_sds, tok, tok).as_text()
+
+
+def sharded_sync_budget(codec: str = "f32") -> tuple[int, int]:
+    """(number of ZeRO buckets, number of synced leaves) for the sharded
+    dense entrypoint above, from the very bucket plan the step executes —
+    one grad reduce-scatter AND one param all-gather per bucket on the
+    dp-only flat(8) plan (for int8: 2 grouped all_to_alls per bucket for
+    the grads — i8 payload + f32 scales — and 2 all_gathers for the
+    params)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.quantize import get_codec
+    from ..parallel.bucketing import plan_buckets, replication_key
+    from ..parallel.train import init_train_state, state_specs, TrainConfig
+
+    model_cfg = _small_model_cfg()
+    state_sds = jax.eval_shape(
+        lambda k: init_train_state(k, model_cfg), jax.random.PRNGKey(0)
+    )
+    pspecs = state_specs(model_cfg, "tp")["params"]
+    flat_g, treedef = jax.tree.flatten(state_sds["params"])
+    flat_s = treedef.flatten_up_to(pspecs)
+    axis_sizes = {"dp": 8, "sp": 1, "tp": 1}
+    c = get_codec(codec)
+    buckets = plan_buckets(
+        flat_g, flat_s, ("dp", "sp", "tp"),
+        axis_sizes=axis_sizes, bucket_bytes=1 << 30,
+        codec=c if c.lossy else None, sharded=True,
+    )
+    n_synced = sum(
+        1
+        for s in flat_s
+        if any(axis_sizes[a] > 1 for a in replication_key(s, ("dp", "sp", "tp")))
+    )
+    return len(buckets), n_synced
+
+
 def _lower_moe_step() -> str:
     import jax
     import jax.numpy as jnp
@@ -584,6 +799,40 @@ def lower_entrypoints(full: bool = True) -> list[tuple[str, str, HloBudget]]:
                 note="donated input must lower with jax.buffer_donor",
             ),
         ),
+        (
+            "reduce_scatter_f32_4x2",
+            _lower_split_collective((4, 2), "rs"),
+            HloBudget(
+                reduce_scatter=2, all_gather=0, all_reduce=0,
+                collective_permute=0,
+                collective_dtypes=("f32",),
+                note="phase 1 alone: one grouped reduce-scatter per stage, "
+                     "NO allgather — the split seam (PR 7)",
+            ),
+        ),
+        (
+            "all_gather_f32_4x2",
+            _lower_split_collective((4, 2), "ag"),
+            HloBudget(
+                reduce_scatter=0, all_gather=2, all_reduce=0,
+                collective_permute=0,
+                collective_dtypes=("f32",),
+                note="phase 2 alone: one grouped allgather per stage, NO "
+                     "reduce-scatter",
+            ),
+        ),
+        (
+            "reduce_scatter_int8_4x2",
+            _lower_split_collective((4, 2), "rs", codec="int8"),
+            HloBudget(
+                reduce_scatter=0, all_gather=0, all_reduce=0,
+                collective_permute=0, all_to_all=4,
+                collective_dtypes=("i8", "f32"),
+                require_wire_dtype="i8",
+                note="compressed phase 1: per-stage grouped (i8 payload, "
+                     "f32 scales) all_to_alls; int8 stays i8 on the wire",
+            ),
+        ),
     ]
     if not full:
         return rows
@@ -681,6 +930,45 @@ def lower_entrypoints(full: bool = True) -> list[tuple[str, str, HloBudget]]:
             ),
         )
     )
+
+    # ZeRO-1 sharded entrypoints (PR 7): one grad reduce-scatter + one
+    # param all-gather per bucket, and the gather must FOLLOW the
+    # optimizer update — a step that gathers grads instead is the
+    # replicated schedule in disguise (the shard-regather mutant)
+    nz, nz_leaves = sharded_sync_budget()
+    rows.append(
+        (
+            "train_step_sharded",
+            _lower_sharded_train_step(),
+            HloBudget(
+                reduce_scatter=nz, all_gather=nz, collective_permute=0,
+                require_gather_after_update=True,
+                note=(
+                    f"sharded sync: {nz} buckets over {nz_leaves} synced "
+                    f"leaves — one grad rs + one PARAM ag per bucket, "
+                    f"gather after the shard update"
+                ),
+            ),
+        )
+    )
+    nz_i8, _ = sharded_sync_budget("int8")
+    rows.append(
+        (
+            "train_step_sharded_int8",
+            _lower_sharded_train_step(codec="int8"),
+            HloBudget(
+                reduce_scatter=0, all_gather=2 * nz_i8,
+                all_to_all=2 * nz_i8, collective_permute=0,
+                require_wire_dtype="i8",
+                require_gather_after_update=True,
+                note=(
+                    "sharded int8: grads ride grouped (i8, scales) "
+                    "all_to_alls, params ride encoded-forwarding gathers "
+                    "— int8 stays i8 on the reduce-scatter wire"
+                ),
+            ),
+        )
+    )
     return rows
 
 
@@ -737,6 +1025,26 @@ def lower_overlap_serialized_train_step() -> tuple[str, HloBudget]:
         require_compute_after_collective=True,
         note=f"overlapped budget applied to the {n_segments}-segment "
              f"barrier twin",
+    )
+    return ir, budget
+
+
+def lower_shard_regather_train_step() -> tuple[str, HloBudget]:
+    """The 'shard-regather' corruption: a "sharded" step that secretly
+    all-gathers GRADIENTS instead of updated parameters — which is
+    exactly the replicated step over the same flat(8) bucket plan
+    (identical collective counts: one rs + one ag per bucket;
+    bitwise-identical f32 numerics; optimizer state silently fully
+    replicated again).  Only the program-ORDER check can see it: every
+    all_gather precedes the optimizer sqrt."""
+    _require_devices(8)
+    nz, nz_leaves = sharded_sync_budget()
+    ir = _lower_sharded_train_step(regather=True)
+    budget = HloBudget(
+        reduce_scatter=nz, all_gather=nz, collective_permute=0,
+        require_gather_after_update=True,
+        note=f"sharded budget applied to the grad-regathering "
+             f"({nz_leaves}-leaf replicated) step",
     )
     return ir, budget
 

@@ -23,6 +23,7 @@ leaf-unrolled         2       ``budget``
 dtype-drift           2       ``dtype-drift``
 codec-upcast          2       ``codec-upcast``
 overlap-serialization 2       ``overlap-serialization``
+shard-regather        2       ``shard-regather`` (grads regathered)
 wall-clock            3       ``wall-clock``
 host-rng              3       ``rng``
 traced-branch         3       ``traced-branch``
@@ -169,6 +170,13 @@ def _mutate_overlap_serialization():
     return lint_ir("mutated:overlap_serialized_train_step", ir, budget)
 
 
+def _mutate_shard_regather():
+    from .hlo_lint import lint_ir, lower_shard_regather_train_step
+
+    ir, budget = lower_shard_regather_train_step()
+    return lint_ir("mutated:shard_regather_train_step", ir, budget)
+
+
 # ----------------------------------------------------- layer 3 mutations
 
 _HYGIENE_MUTANT = '''
@@ -215,6 +223,7 @@ MUTATIONS = {
     "overlap-serialization": (
         "overlap-serialization", "hlo", _mutate_overlap_serialization,
     ),
+    "shard-regather": ("shard-regather", "hlo", _mutate_shard_regather),
     "wall-clock": ("wall-clock", "jit", _mutate_hygiene("wall-clock")),
     "host-rng": ("rng", "jit", _mutate_hygiene("rng")),
     "traced-branch": ("traced-branch", "jit", _mutate_hygiene("traced-branch")),

@@ -447,6 +447,13 @@ class TrainStepBenchConfig:
     # suite (one vjp per layer) and pre-existing callers' artifacts
     # (BENCH_BUCKETING.json) keep their historical row schema.
     overlap: bool = False
+    # add the ZeRO-1 sharded rows (PR 7): ``ours_sharded`` (f32 — updated
+    # params asserted bitwise-identical to per-leaf) and
+    # ``ours_sharded_int8`` (both wires quantized), each with the
+    # per-rank optimizer-state ratio from the live layout
+    # (zero.zero_shard_bytes).  Default False for the same
+    # artifact-schema reason as ``overlap``.
+    sharded: bool = False
 
 
 def make_nosync_train_step(mesh, model_cfg, train_cfg, axis_names=("dp", "sp", "tp")):
@@ -590,6 +597,40 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
             if name != "no_sync":
                 states_out[name] = out
 
+    sharded_states: dict = {}
+    shard_bytes = None
+    if cfg.sharded:
+        import dataclasses as _dc
+
+        from ..models.transformer import init_params, param_specs
+        from ..parallel.train import zero_layout_for
+        from ..parallel.zero import zero_shard_bytes
+
+        tc_sh = TrainConfig(grad_topo=cfg.topo, shard_optimizer=True)
+        for name, tc2 in (
+            ("ours_sharded", tc_sh),
+            ("ours_sharded_int8", _dc.replace(tc_sh, codec="int8")),
+        ):
+            st2 = init_train_state(
+                jax.random.PRNGKey(0), model_cfg, tc2, mesh=mesh
+            )
+            steps[name] = make_train_step(mesh, model_cfg, tc2)
+            sharded_states[name] = st2
+            out, _ = jax.block_until_ready(steps[name](st2, toks, tgts))
+            states_out[name] = out
+        shapes = jax.eval_shape(
+            lambda k: init_params(k, model_cfg), jax.random.PRNGKey(0)
+        )
+        layout = zero_layout_for(
+            mesh, shapes, param_specs(model_cfg, "tp"), ("dp", "sp", "tp")
+        )
+        # per-variant accounting: the int8 state additionally carries the
+        # sharded f32 master copy (lossy=True), so its ratio is higher
+        shard_bytes = {
+            "ours_sharded": zero_shard_bytes(layout),
+            "ours_sharded_int8": zero_shard_bytes(layout, lossy=True),
+        }
+
     supervised_ctx = None
     if cfg.supervised:
         # the fault-free supervision host path around the fused step: the
@@ -628,7 +669,10 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
                 steps["ours_fused_supervised"](state, toks, tgts)
             )
         step_times = _interleaved_times(
-            {n: (fn, (state, toks, tgts)) for n, fn in steps.items()},
+            {
+                n: (fn, (sharded_states.get(n, state), toks, tgts))
+                for n, fn in steps.items()
+            },
             cfg.repeat,
         )
         sync_times = _interleaved_times(
@@ -709,10 +753,24 @@ def run_train_step_bench(cfg: TrainStepBenchConfig) -> dict:
             / rows["ours_fused"]["train_step_ms"],
         }
 
+    if cfg.sharded:
+        for name in ("ours_sharded", "ours_sharded_int8"):
+            rows[name] = {
+                "train_step_ms": step_times[name]["min_ms"],
+                "train_step_avg_ms": step_times[name]["avg_ms"],
+                "vs_per_leaf": (
+                    rows["per_leaf"]["train_step_ms"]
+                    / step_times[name]["min_ms"]
+                ),
+                "opt_state_bytes_ratio": shard_bytes[name]["ratio"],
+            }
+
     identical = True
     variants = ["ours_fused", "ours_chunked"]
     if cfg.overlap:
         variants += ["ours_overlapped", "ours_overlap_serialized"]
+    if cfg.sharded:
+        variants += ["ours_sharded"]  # int8 is lossy: bounded, not bitwise
     for name in variants:
         same = all(
             np.asarray(a).tobytes() == np.asarray(b).tobytes()

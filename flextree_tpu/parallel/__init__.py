@@ -5,6 +5,7 @@ sequence parallelism, and the dp/sp/tp sharded training step.
 """
 
 from .allreduce import (
+    all_gather,
     allgather,
     allreduce,
     lonely_allreduce,
@@ -45,6 +46,7 @@ __all__ = [
     "lonely_allreduce",
     "ring_allreduce",
     "reduce_scatter",
+    "all_gather",
     "allgather",
     "allreduce_over_mesh",
     "flat_mesh",

@@ -41,8 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.reduce import ReduceOp, get_op
-from ..schedule.blocks import BlockLayout
-from ..schedule.stages import LonelyTopology, Topology, TopologyError
+from ..schedule.stages import LonelyTopology, Topology
 
 __all__ = [
     "allreduce",
@@ -50,6 +49,7 @@ __all__ = [
     "lonely_allreduce",
     "ring_allreduce",
     "reduce_scatter",
+    "all_gather",
     "allgather",
 ]
 
@@ -61,15 +61,6 @@ _NATIVE_PSUM = lax.psum
 
 def _jnp_fn(rop: ReduceOp):
     return getattr(jnp, rop.jnp_name)
-
-
-def _flatten_pad(x: jax.Array, n: int, rop: ReduceOp):
-    """Flatten to 1-D and pad to ``split_size * n`` with the op identity."""
-    v = x.reshape(-1)
-    layout = BlockLayout(n, v.size)
-    if layout.pad:
-        v = jnp.pad(v, (0, layout.pad), constant_values=rop.identity_for(x.dtype))
-    return v, layout
 
 
 def _groups_or_none(topo: Topology, stage: int):
@@ -453,62 +444,235 @@ def ring_allreduce(x: jax.Array, axis_name, op="sum") -> jax.Array:
 
 # --------------------------------------------------------------------------
 # separable phases (reference phases 1/2 as standalone collectives, §2.6)
+#
+# First-class split collectives (PR 7): ``all_gather(reduce_scatter(x)) ==
+# allreduce(x)`` BITWISE for op='sum', any count, any tree/ring/lonely
+# shape — because both halves are literally the code paths ``allreduce``
+# composes.  The shard-layout contract (``schedule.blocks.owned_block``):
+# the divisible head splits into N blocks and rank ``r`` owns block
+# ``owned_block(topo, r)`` (mixed-radix residue chain for trees, ``(r+1) %
+# N`` for the ring, buddy-mirrored for lonely shapes); the <N-element tail
+# is reduced by ONE dense collective and returned REPLICATED on every
+# rank, appended after the owned block — the same head/tail split
+# ``tree_allreduce`` uses, so no pad/slice copies and no association
+# change.  A rank's shard is therefore ``head/N + tail`` elements; for
+# divisible counts it is a pure 1/N partition.
 # --------------------------------------------------------------------------
 
 
-def reduce_scatter(x: jax.Array, axis_name, topo=None, op="sum") -> jax.Array:
-    """Phase 1 alone: returns this rank's reduced 1/N tile (padded layout).
+def _shard_split(count: int, n: int) -> tuple[int, int]:
+    """(head, tile) for a ``count``-element buffer over ``n`` owners."""
+    tile = count // n
+    return tile * n, tile
 
-    The tile this rank owns is the composition of its per-stage group
-    positions — the residue-chain ownership of SURVEY §3.2 in the padded,
-    contiguous-tile coordinate system the XLA lowering uses.
+
+def _ring_reduce_scatter(head, axis_name, n: int, rop: ReduceOp):
+    """Phase 1 of the ring alone: the (N-1)-step fold walk of
+    ``ring_allreduce``; on exit this rank's fully-reduced block is
+    ``(idx + 1) % N`` (the block the gather phase starts forwarding,
+    ``mpi_mod.hpp:1149``), which is what gets returned."""
+    fn = _jnp_fn(rop)
+    split = head.shape[0] // n
+    idx = lax.axis_index(axis_name)
+    right_perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def reduce_step(s, v):
+        send_b = (idx - s) % n
+        recv_b = (idx - s - 1) % n
+        chunk = lax.dynamic_slice_in_dim(v, send_b * split, split, axis=0)
+        got = lax.ppermute(chunk, axis_name, right_perm)
+        cur = lax.dynamic_slice_in_dim(v, recv_b * split, split, axis=0)
+        return lax.dynamic_update_slice_in_dim(v, fn(cur, got), recv_b * split, axis=0)
+
+    v = lax.fori_loop(0, n - 1, reduce_step, head, unroll=False)
+    own_b = (idx + 1) % n
+    return lax.dynamic_slice_in_dim(v, own_b * split, split, axis=0)
+
+
+def _ring_allgather(tile_v, axis_name, n: int):
+    """Phase 2 of the ring alone: place the owned block ``(idx + 1) % N``
+    into a zero buffer and run the (N-1)-step forwarding walk — every
+    block this rank receives is some rank's fully-reduced block, so the
+    assembled buffer is bitwise the ``ring_allreduce`` result."""
+    split = tile_v.shape[0]
+    idx = lax.axis_index(axis_name)
+    right_perm = [(j, (j + 1) % n) for j in range(n)]
+    out = jnp.zeros((n * split,) + tile_v.shape[1:], tile_v.dtype)
+    own_b = (idx + 1) % n
+    out = lax.dynamic_update_slice_in_dim(out, tile_v, own_b * split, axis=0)
+
+    def gather_step(s, v):
+        send_b = (idx + 1 - s) % n
+        recv_b = (idx - s) % n
+        chunk = lax.dynamic_slice_in_dim(v, send_b * split, split, axis=0)
+        got = lax.ppermute(chunk, axis_name, right_perm)
+        return lax.dynamic_update_slice_in_dim(v, got, recv_b * split, axis=0)
+
+    return lax.fori_loop(0, n - 1, gather_step, out, unroll=False)
+
+
+def _lonely_reduce_scatter(head, axis_name, topo: LonelyTopology, rop: ReduceOp):
+    """Phase 1 of the lonely shape alone: buddy fold, prefix-tree RS
+    stages, then ONE extra ppermute shipping each buddy's reduced tile to
+    its lonely rank — lonely rank ``m + i`` ends holding a bitwise COPY of
+    buddy ``i``'s owned block (the mirror contract of
+    ``schedule.blocks.owned_block``)."""
+    tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
+    fn = _jnp_fn(rop)
+    idx = lax.axis_index(axis_name)
+    with jax.named_scope("ft_lonely_fold"):
+        got = lax.ppermute(head, axis_name, [(m + i, i) for i in range(l)])
+        head = jnp.where(idx < l, fn(head, got), head)
+    for i, w in enumerate(tree.widths):
+        with jax.named_scope(f"ft_lonely_rs_stage{i}_w{w}"):
+            head = _grouped_reduce_scatter_generic(head, axis_name, tree, i, rop)
+    with jax.named_scope("ft_lonely_ship_shard"):
+        shipped = lax.ppermute(head, axis_name, [(i, m + i) for i in range(l)])
+        return jnp.where(idx >= m, shipped, head)
+
+
+def _lonely_allgather(tile_v, axis_name, topo: LonelyTopology):
+    """Phase 2 of the lonely shape alone: prefix-tree AG stages over the
+    tree ranks (lonely ranks' mirrored tiles are ignored — they are
+    outside every stage permutation and compute garbage), then the
+    restore ppermute hands the assembled head to the lonely ranks —
+    exactly ``lonely_allreduce``'s phase 2, so the composition is bitwise
+    the full lonely allreduce."""
+    tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
+    idx = lax.axis_index(axis_name)
+    head = tile_v
+    for i in reversed(range(tree.num_stages)):
+        with jax.named_scope(f"ft_lonely_ag_stage{i}_w{tree.widths[i]}"):
+            head = _grouped_allgather_generic(head, axis_name, tree, i)
+    with jax.named_scope("ft_lonely_restore"):
+        got = lax.ppermute(head, axis_name, [(i, m + i) for i in range(l)])
+        return jnp.where(idx >= m, got, head)
+
+
+def reduce_scatter(
+    x: jax.Array, axis_name, topo=None, op="sum", codec=None, step=0,
+    return_residual: bool = False,
+):
+    """Phase 1 alone: this rank's reduced shard of ``x``.
+
+    Returns a 1-D buffer of ``count // N + count % N`` elements: the owned
+    1/N head block (``schedule.blocks.owned_block`` says which) followed
+    by the <N-element tail, reduced by one dense collective and replicated
+    on every rank (``tree_allreduce``'s exact tail path, so the
+    ``all_gather ∘ reduce_scatter == allreduce`` contract is bitwise).
+    Lonely shapes: lonely ranks hold a bitwise copy of their buddy's
+    shard.  For lonely topologies the head splits over the ``m`` TREE
+    ranks (shard is ``count // m + count % m`` elements).
+
+    ``codec`` (``ops/quantize.py``): a lossy codec compresses the phase-1
+    wire per hop (``parallel.compressed.compressed_reduce_scatter``);
+    ``return_residual=True`` additionally returns the local
+    input-quantization residual for error feedback (zeros when exact).
     """
+    from ..ops.quantize import get_codec
+
+    c = get_codec(codec)
+    if c.lossy:
+        from .compressed import compressed_reduce_scatter
+
+        return compressed_reduce_scatter(
+            x, axis_name, topo=topo, codec=c, step=step,
+            return_residual=return_residual,
+        )
     n = lax.axis_size(axis_name)
     rop = get_op(op)
     rop.check_dtype(x.dtype)
     if n <= 1:
-        return x.reshape(-1)
+        out = x.reshape(-1)
+        return (out, jnp.zeros_like(out)) if return_residual else out
     topo = Topology.resolve(n, topo)
-    if isinstance(topo, LonelyTopology):
-        # lonely ranks own no block, so the phases aren't separable — the
-        # buddy fold/restore only makes sense around a full allreduce
-        raise TopologyError(
-            f"reduce_scatter does not support lonely topologies ({topo}); "
-            "use allreduce, or a product-of-widths shape"
-        )
-    v, _ = _flatten_pad(x, n, rop)
-    if topo.is_ring:
-        flat = Topology.flat(n)
-        return _tree_reduce_scatter(v, axis_name, flat, rop)
-    return _tree_reduce_scatter(v, axis_name, topo, rop)
+    owners = topo.tree.num_nodes if isinstance(topo, LonelyTopology) else n
+    v = x.reshape(-1)
+    head, tail = _split_main_tail(v, owners)
+    parts = []
+    if head is not None:
+        if isinstance(topo, LonelyTopology):
+            parts.append(_lonely_reduce_scatter(head, axis_name, topo, rop))
+        elif topo.is_ring:
+            parts.append(_ring_reduce_scatter(head, axis_name, n, rop))
+        else:
+            parts.append(_tree_reduce_scatter(head, axis_name, topo, rop))
+    if tail is not None:
+        parts.append(_small_dense_allreduce(tail, axis_name, rop))
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return (out, jnp.zeros_like(x)) if return_residual else out
 
 
-def allgather(x: jax.Array, axis_name, topo=None, out_shape=None) -> jax.Array:
+def all_gather(
+    x: jax.Array, axis_name, topo=None, out_shape=None, codec=None, step=0
+) -> jax.Array:
     """Phase 2 alone: inverse of ``reduce_scatter`` on the same topology.
 
-    ``out_shape``: the original (pre-``reduce_scatter``) array shape.  When
-    the element count wasn't divisible by N, ``reduce_scatter`` padded to
-    ``split_size*N`` (``data_size_aligned``, ``mpi_mod.hpp:232``); passing
-    ``out_shape`` slices that padding back off and restores the shape, so
-    ``allgather(reduce_scatter(x, ...), ..., out_shape=x.shape)`` is a full
-    allreduce for any count.
+    ``x`` is a shard in ``reduce_scatter``'s layout (owned head block +
+    replicated tail); the head blocks are gathered in block order and the
+    local tail appended, so the result is the full reduced buffer —
+    bitwise what ``allreduce`` would have produced.  ``out_shape``
+    restores the original array shape (the flat result already has the
+    exact element count).
+
+    ``codec``: a lossy codec forwards the head block encoded
+    (``parallel.compressed.compressed_all_gather``) — one lossy event for
+    the whole phase; every rank decodes identical bytes, so replicas
+    cannot drift.
     """
+    from ..ops.quantize import get_codec
+
+    c = get_codec(codec)
+    if c.lossy:
+        from .compressed import compressed_all_gather
+
+        return compressed_all_gather(
+            x, axis_name, topo=topo, out_shape=out_shape, codec=c, step=step
+        )
     n = lax.axis_size(axis_name)
-    if n <= 1:
-        pass
-    else:
+    if n > 1:
         topo = Topology.resolve(n, topo)
-        if isinstance(topo, LonelyTopology):
-            raise TopologyError(
-                f"allgather does not support lonely topologies ({topo}); "
-                "use allreduce, or a product-of-widths shape"
-            )
-        if topo.is_ring:
-            topo = Topology.flat(n)
-        x = _tree_allgather(x, axis_name, topo)
+        owners = topo.tree.num_nodes if isinstance(topo, LonelyTopology) else n
+        v = x.reshape(-1)
+        # shard layout = [owned head block (T elems) || replicated tail (t
+        # elems, t < owners)].  The split is ambiguous from the shard
+        # length alone (T + t), so derive it from ``out_shape`` when given
+        # (T = count // owners); without it the shard is taken as a pure
+        # partition (t = 0) — the divisible-count case.
+        shard_len = v.shape[0]
+        if out_shape is not None:
+            count = 1
+            for d in out_shape:
+                count *= d
+            tile = count // owners
+            if tile + count % owners != shard_len:
+                raise ValueError(
+                    f"shard of {shard_len} elements does not match "
+                    f"out_shape {out_shape} over {owners} owners "
+                    f"(expected {tile + count % owners})"
+                )
+        else:
+            tile = shard_len
+        head_tile, tail = v[:tile], v[tile:]
+        parts = []
+        if tile:
+            if isinstance(topo, LonelyTopology):
+                parts.append(_lonely_allgather(head_tile, axis_name, topo))
+            elif topo.is_ring:
+                parts.append(_ring_allgather(head_tile, axis_name, n))
+            else:
+                parts.append(_tree_allgather(head_tile, axis_name, topo))
+        if tail.shape[0]:
+            parts.append(tail)
+        x = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     if out_shape is not None:
         count = 1
         for d in out_shape:
             count *= d
         x = x.reshape(-1)[:count].reshape(out_shape)
     return x
+
+
+def allgather(x: jax.Array, axis_name, topo=None, out_shape=None) -> jax.Array:
+    """Backward-compatible alias for :func:`all_gather`."""
+    return all_gather(x, axis_name, topo=topo, out_shape=out_shape)

@@ -135,6 +135,7 @@ def plan_buckets(
     params=None,
     max_bucket_bytes: int | None = None,
     codec=None,
+    sharded: bool = False,
 ) -> tuple[Bucket, ...]:
     """Partition flattened gradient leaves into fused sync buckets.
 
@@ -169,7 +170,7 @@ def plan_buckets(
         if cap is None:
             cap = _derived_bucket_bytes(
                 sum(sizes), len(idxs), axes, topos or {}, axis_sizes or {},
-                params, max_bucket_bytes, codec,
+                params, max_bucket_bytes, codec, sharded=sharded,
             )
         cap = max(int(cap), 1)
         cur: list[int] = []
@@ -187,13 +188,16 @@ def plan_buckets(
 
 def _derived_bucket_bytes(
     total_bytes, n_leaves, axes, topos, axis_sizes, params, max_bucket_bytes,
-    codec=None,
+    codec=None, sharded: bool = False,
 ):
     """Planner-derived bucket size for one (axes, dtype) group: the sync
     runs one allreduce per axis per bucket, so the launch term the chooser
     amortizes is the sum of the per-axis fixed costs.  ``codec`` makes the
     chooser's byte terms wire-accurate for compressed syncs (fewer wire
-    bytes per bucket -> the argmin shifts toward fewer, larger buckets)."""
+    bytes per bucket -> the argmin shifts toward fewer, larger buckets).
+    ``sharded`` prices the ZeRO split schedule instead (grad
+    reduce-scatter + param all-gather on the first axis, shard-sized
+    allreduce on the rest — ``planner.choose_bucket_bytes``)."""
     from ..planner.choose import choose_bucket_bytes
 
     cost_topos = []
@@ -208,7 +212,8 @@ def _derived_bucket_bytes(
     if not cost_topos:
         return max_bucket_bytes
     derived = choose_bucket_bytes(
-        total_bytes, cost_topos, n_leaves=n_leaves, params=params, codec=codec
+        total_bytes, cost_topos, n_leaves=n_leaves, params=params, codec=codec,
+        sharded=sharded,
     )
     return min(derived, max_bucket_bytes)
 

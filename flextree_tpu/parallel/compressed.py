@@ -46,16 +46,28 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.quantize import Codec, decode_int8, encode_int8, get_codec
+from ..ops.reduce import get_op
 from ..schedule.stages import LonelyTopology, Topology
 from .allreduce import (
     _NATIVE_PSUM,
     _groups_or_none,
+    _lonely_allgather,
+    _lonely_reduce_scatter,
     _next_in_group,
+    _ring_allgather,
+    _ring_reduce_scatter,
     _split_main_tail,
+    _tree_allgather,
+    _tree_reduce_scatter,
     allreduce,
 )
 
-__all__ = ["compressed_allreduce", "local_residual"]
+__all__ = [
+    "compressed_allreduce",
+    "compressed_reduce_scatter",
+    "compressed_all_gather",
+    "local_residual",
+]
 
 # salt namespaces so no two encode sites share a stochastic-rounding
 # stream: phase-1 stage i uses salt i (stage 0 == the canonical salt 0 of
@@ -151,6 +163,157 @@ def local_residual(x: jax.Array, codec, step=0) -> jax.Array:
     return x - codec.roundtrip(x, step)
 
 
+# ------------------------------------------------- split phases (PR 7)
+#
+# ``compressed_allreduce`` composes a per-hop-compressed reduce-scatter
+# with an encoded-forwarding allgather; these entry points expose the two
+# halves as first-class collectives with the SAME shard layout as the
+# uncompressed split (``parallel.allreduce.reduce_scatter``: owned head
+# block per ``schedule.blocks.owned_block``, <N tail reduced dense in
+# exact f32 and replicated).  Salts match the fused paths, so for
+# block-aligned buffers ``compressed_all_gather(compressed_reduce_scatter
+# (x)) == compressed_allreduce(x)`` bitwise per codec.
+
+
+def compressed_reduce_scatter(
+    x: jax.Array,
+    axis_name,
+    topo=None,
+    codec="int8",
+    step=0,
+    return_residual: bool = False,
+):
+    """Phase 1 alone with ``codec`` on the wire: this rank's reduced shard
+    (owned head block + exact-f32 replicated tail).  Sum-only.
+
+    ``return_residual=True`` also returns the local input-quantization
+    residual ``x - C(x)`` for error feedback: the wire-exact first-hop
+    encode for tree shapes, the canonical local map for ring/lonely (the
+    same rule as ``compressed_allreduce``); the tail is exact, so its
+    residual is 0.
+    """
+    codec = get_codec(codec)
+    n = lax.axis_size(axis_name)
+    if not codec.lossy or n <= 1:
+        from .allreduce import reduce_scatter
+
+        out = reduce_scatter(x, axis_name, topo=topo, op="sum")
+        if return_residual:
+            return out, jnp.zeros_like(x)
+        return out
+    topo = Topology.resolve(n, topo)
+    owners = topo.tree.num_nodes if isinstance(topo, LonelyTopology) else n
+    shape = x.shape
+    v = x.reshape(-1).astype(jnp.float32)
+    head, tail = _split_main_tail(v, owners)
+
+    parts: list[jax.Array] = []
+    res = jnp.zeros_like(v)
+    if codec.name == "bf16":
+        if head is not None:
+            wire = head.astype(jnp.bfloat16)
+            rop = get_op("sum")
+            if isinstance(topo, LonelyTopology):
+                tile = _lonely_reduce_scatter(wire, axis_name, topo, rop)
+            elif topo.is_ring:
+                tile = _ring_reduce_scatter(wire, axis_name, n, rop)
+            else:
+                tile = _tree_reduce_scatter(wire, axis_name, topo, rop)
+            parts.append(tile.astype(jnp.float32))
+            res = res.at[: head.shape[0]].set(head - wire.astype(jnp.float32))
+    elif head is not None:
+        if isinstance(topo, LonelyTopology):
+            tile = _lonely_rs_int8(head, axis_name, topo, codec, step)
+            own = decode_int8(
+                *encode_int8(head, step, salt=0, block=codec.block),
+                head.shape[0], block=codec.block,
+            )
+            parts.append(tile)
+            res = res.at[: head.shape[0]].set(head - own)
+        elif topo.is_ring:
+            tile = _ring_rs_int8(head, axis_name, n, codec, step)
+            own = decode_int8(
+                *encode_int8(head, step, salt=0, block=codec.block),
+                head.shape[0], block=codec.block,
+            )
+            parts.append(tile)
+            res = res.at[: head.shape[0]].set(head - own)
+        else:
+            tile, own0 = _tree_rs_int8_all_stages(head, axis_name, topo, codec, step)
+            parts.append(tile)
+            res = res.at[: head.shape[0]].set(head - own0)
+    if tail is not None:
+        parts.append(_NATIVE_PSUM(tail, axis_name))
+    if not parts:
+        out = jnp.zeros((0,), x.dtype)
+    else:
+        out = (parts[0] if len(parts) == 1 else jnp.concatenate(parts)).astype(
+            x.dtype
+        )
+    if return_residual:
+        return out, res.reshape(shape).astype(x.dtype)
+    return out
+
+
+def compressed_all_gather(
+    x: jax.Array, axis_name, topo=None, out_shape=None, codec="int8", step=0
+) -> jax.Array:
+    """Phase 2 alone with ``codec`` on the wire: the owned head block is
+    encoded ONCE and forwarded still-encoded through the stage gathers
+    (one lossy event for the whole phase); every rank decodes identical
+    bytes, so replicas cannot drift — including the owner, which adopts
+    ``decode(encode(tile))`` rather than its exact local tile.  The tail
+    part of the shard is appended locally, exact."""
+    codec = get_codec(codec)
+    n = lax.axis_size(axis_name)
+    if not codec.lossy or n <= 1:
+        from .allreduce import all_gather
+
+        return all_gather(x, axis_name, topo=topo, out_shape=out_shape)
+    topo = Topology.resolve(n, topo)
+    owners = topo.tree.num_nodes if isinstance(topo, LonelyTopology) else n
+    v = x.reshape(-1).astype(jnp.float32)
+    shard_len = v.shape[0]
+    if out_shape is not None:
+        count = 1
+        for d in out_shape:
+            count *= d
+        tile = count // owners
+        if tile + count % owners != shard_len:
+            raise ValueError(
+                f"shard of {shard_len} elements does not match out_shape "
+                f"{out_shape} over {owners} owners"
+            )
+    else:
+        tile = shard_len
+    head_tile, tail = v[:tile], v[tile:]
+    parts: list[jax.Array] = []
+    if tile:
+        if codec.name == "bf16":
+            wire = head_tile.astype(jnp.bfloat16)
+            if isinstance(topo, LonelyTopology):
+                full = _lonely_allgather(wire, axis_name, topo)
+            elif topo.is_ring:
+                full = _ring_allgather(wire, axis_name, n)
+            else:
+                full = _tree_allgather(wire, axis_name, topo)
+            parts.append(full.astype(jnp.float32))
+        elif isinstance(topo, LonelyTopology):
+            parts.append(_lonely_ag_int8(head_tile, axis_name, topo, codec, step))
+        elif topo.is_ring:
+            parts.append(_ring_ag_int8(head_tile, axis_name, n, codec, step))
+        else:
+            parts.append(
+                _ag_int8(head_tile, axis_name, topo, codec, step, _SALT_AG)
+            )
+    if tail.shape[0]:
+        parts.append(tail)
+    out = (parts[0] if len(parts) == 1 else jnp.concatenate(parts)).astype(x.dtype)
+    if out_shape is not None:
+        out = out.reshape(-1)[:count].reshape(out_shape)
+    return out
+
+
 # --------------------------------------------------------------- tree
 
 
@@ -193,6 +356,19 @@ def _ag_int8(tile_v, axis_name, topo: Topology, codec: Codec, step, salt):
     return dec.reshape(-1)
 
 
+def _tree_rs_int8_all_stages(piece, axis_name, topo: Topology, codec: Codec, step):
+    """All phase-1 stages of the compressed tree: returns (reduced tile,
+    stage-0 own-encode roundtrip of the whole input) — the latter is the
+    wire-exact residual reference for error feedback."""
+    own0 = None
+    v = piece
+    for i in range(topo.num_stages):
+        v, own = _stage_rs_int8(v, axis_name, topo, i, codec, step)
+        if i == 0:
+            own0 = own
+    return v, own0
+
+
 def _tree_int8(head, axis_name, topo: Topology, codec: Codec, chunks: int, step):
     """Compressed k-ary tree on the divisible head, optionally
     chunk-pipelined with the same phase-2/phase-1 interleaving as
@@ -202,13 +378,7 @@ def _tree_int8(head, axis_name, topo: Topology, codec: Codec, chunks: int, step)
     n = topo.num_nodes
 
     def rs_all(piece):
-        own0 = None
-        v = piece
-        for i in range(topo.num_stages):
-            v, own = _stage_rs_int8(v, axis_name, topo, i, codec, step)
-            if i == 0:
-                own0 = own
-        return v, own0
+        return _tree_rs_int8_all_stages(piece, axis_name, topo, codec, step)
 
     sizes = _chunk_sizes(head.size, n, chunks)
     if len(sizes) == 1:
@@ -242,15 +412,12 @@ def _tree_int8(head, axis_name, topo: Topology, codec: Codec, chunks: int, step)
 # --------------------------------------------------------------- ring
 
 
-def _ring_int8(head, axis_name, n: int, codec: Codec, step):
-    """Compressed ring: per-hop encode of the sent block, f32 fold at the
-    receiver; phase 2 forwards blocks still encoded.  The residual is the
-    canonical local map (ring blocks are first encoded at differing fold
-    depths, so no single wire encode covers the whole local buffer — see
-    docs/QUANTIZED_COLLECTIVES.md)."""
+def _ring_rs_int8(head, axis_name, n: int, codec: Codec, step):
+    """Compressed ring phase 1 alone: per-hop encode of the sent block,
+    f32 fold at the receiver; returns the fully-reduced owned block
+    ``(idx + 1) % n`` in f32 (never end-quantized — phase 2 owns that
+    lossy event)."""
     split = head.shape[0] // n
-    sp = _padded(split, codec.block)
-    nb = sp // codec.block
     idx = lax.axis_index(axis_name)
     right = [(j, (j + 1) % n) for j in range(n)]
     v = head
@@ -267,9 +434,19 @@ def _ring_int8(head, axis_name, n: int, codec: Codec, step):
         cur = lax.dynamic_slice_in_dim(v, recv_b * split, split, axis=0)
         v = lax.dynamic_update_slice_in_dim(v, cur + got, recv_b * split, axis=0)
 
-    # phase 2: encode the owned (fully-reduced) block once, forward encoded
     own_b = (idx + 1) % n
-    own = lax.dynamic_slice_in_dim(v, own_b * split, split, axis=0)
+    return lax.dynamic_slice_in_dim(v, own_b * split, split, axis=0)
+
+
+def _ring_ag_int8(own, axis_name, n: int, codec: Codec, step):
+    """Compressed ring phase 2 alone: encode the owned block once, forward
+    it still encoded around the ring, decode every assembled block."""
+    split = own.shape[0]
+    sp = _padded(split, codec.block)
+    nb = sp // codec.block
+    idx = lax.axis_index(axis_name)
+    right = [(j, (j + 1) % n) for j in range(n)]
+    own_b = (idx + 1) % n
     q, s = encode_int8(own, step, salt=_SALT_RING - 1, block=codec.block)
     qbuf = jnp.zeros((n * sp,), jnp.int8)
     sbuf = jnp.zeros((n * nb,), jnp.float32)
@@ -288,9 +465,20 @@ def _ring_int8(head, axis_name, n: int, codec: Codec, step):
     dec = decode_int8(
         qbuf.reshape(n, sp), sbuf.reshape(n, nb), split, block=codec.block
     )
+    return dec.reshape(-1)
+
+
+def _ring_int8(head, axis_name, n: int, codec: Codec, step):
+    """Compressed ring: the split phases composed (``_ring_rs_int8`` +
+    ``_ring_ag_int8``).  The residual is the canonical local map (ring
+    blocks are first encoded at differing fold depths, so no single wire
+    encode covers the whole local buffer — see
+    docs/QUANTIZED_COLLECTIVES.md)."""
+    own = _ring_rs_int8(head, axis_name, n, codec, step)
+    out = _ring_ag_int8(own, axis_name, n, codec, step)
     res = head - decode_int8(*encode_int8(head, step, salt=0, block=codec.block),
                              head.shape[0], block=codec.block)
-    return dec.reshape(-1), res
+    return out, res
 
 
 # --------------------------------------------------------------- lonely
@@ -352,6 +540,53 @@ def _compressed_grouped_ag(v, axis_name, topo: Topology, stage: int, codec: Code
         sbuf = lax.dynamic_update_slice_in_dim(sbuf, cs, recv_b * nb, axis=0)
     dec = decode_int8(qbuf.reshape(w, tp), sbuf.reshape(w, nb), t, block=codec.block)
     return dec.reshape(-1)
+
+
+def _lonely_rs_int8(head, axis_name, topo: LonelyTopology, codec: Codec, step):
+    """Compressed lonely phase 1 alone: encoded buddy fold, compressed
+    prefix-tree RS stages, then one encoded ppermute shipping each buddy's
+    reduced tile to its lonely rank.  Tree ranks keep their exact f32
+    tile; lonely ranks hold ``decode(encode(tile))`` — the mirror copy is
+    within one quantization step of the buddy's (exactly mirrored for the
+    identity/bf16-representable case), and the allgather ignores it."""
+    tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
+    idx = lax.axis_index(axis_name)
+    t = head.shape[0]
+    with jax.named_scope("ftq_lonely_fold"):
+        q, s = encode_int8(head, step, salt=_SALT_LONELY - 1, block=codec.block)
+        qg = lax.ppermute(q, axis_name, [(m + i, i) for i in range(l)])
+        sg = lax.ppermute(s, axis_name, [(m + i, i) for i in range(l)])
+        got = decode_int8(qg, sg, t, block=codec.block)
+        v = jnp.where(idx < l, head + got, head)
+    for i in range(tree.num_stages):
+        with jax.named_scope(f"ftq_lonely_rs{i}"):
+            v = _compressed_grouped_rs(v, axis_name, tree, i, codec, step)
+    with jax.named_scope("ftq_lonely_ship_shard"):
+        q, s = encode_int8(v, step, salt=_SALT_LONELY - 3, block=codec.block)
+        q2 = lax.ppermute(q, axis_name, [(i, m + i) for i in range(l)])
+        s2 = lax.ppermute(s, axis_name, [(i, m + i) for i in range(l)])
+        shipped = decode_int8(q2, s2, v.shape[0], block=codec.block)
+        return jnp.where(idx >= m, shipped, v)
+
+
+def _lonely_ag_int8(tile, axis_name, topo: LonelyTopology, codec: Codec, step):
+    """Compressed lonely phase 2 alone: compressed prefix-tree AG stages,
+    then the encoded restore with every rank adopting
+    ``decode(encode(result))`` — the same replica-consistency rule as
+    ``_lonely_int8``'s restore."""
+    tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
+    idx = lax.axis_index(axis_name)
+    v = tile
+    for i in reversed(range(tree.num_stages)):
+        with jax.named_scope(f"ftq_lonely_ag{i}"):
+            v = _compressed_grouped_ag(v, axis_name, tree, i, codec, step)
+    with jax.named_scope("ftq_lonely_restore"):
+        q, s = encode_int8(v, step, salt=_SALT_LONELY - 2, block=codec.block)
+        q2 = lax.ppermute(q, axis_name, [(i, m + i) for i in range(l)])
+        s2 = lax.ppermute(s, axis_name, [(i, m + i) for i in range(l)])
+        back = decode_int8(q2, s2, v.shape[0], block=codec.block)
+        own = decode_int8(q, s, v.shape[0], block=codec.block)
+        return jnp.where(idx >= m, back, own)
 
 
 def _lonely_int8(head, axis_name, topo: LonelyTopology, codec: Codec, step):

@@ -125,14 +125,20 @@ class Supervision:
     a timed-out step is retried up to ``max_step_retries`` times when no
     death is confirmed, then the :class:`~flextree_tpu.runtime.StepTimeout`
     propagates.  ``on_shrink(n_alive, plan)``: rebuild hook for the
-    shrink path — return ``None`` to keep the current step, or a
+    shrink path — return ``None`` to keep the current step, a
     ``(step_fn, mesh, state_specs)`` triple for the survivor world (the
-    plan carries the replanned widths).  ``nbytes_hint`` prices that
-    replan.  ``preemption``: a ``runtime.PreemptionGuard`` polled every
-    iteration for the checkpoint-now fast path.  ``background_saver``: a
-    ``runtime.BackgroundSaver`` — periodic saves go through it instead of
-    blocking the step path (the final save stays synchronous, after a
-    drain).
+    plan carries the replanned widths), or a 5-tuple additionally
+    carrying ``(state_pack, state_unpack)`` converters for the survivor
+    world — the ZeRO-1 re-shard path: sharded runs checkpoint in the
+    CONSOLIDATED layout (``fit``'s ``state_pack``), so after a shrink the
+    survivors restore the full CRC-verified checkpoint and re-partition
+    it into their new owned shards (``state_unpack`` =
+    ``parallel.zero.make_reshard_fn`` for the new world).  ``nbytes_hint``
+    prices that replan.  ``preemption``: a ``runtime.PreemptionGuard``
+    polled every iteration for the checkpoint-now fast path.
+    ``background_saver``: a ``runtime.BackgroundSaver`` — periodic saves
+    go through it instead of blocking the step path (the final save
+    stays synchronous, after a drain).
     """
 
     supervisor: Any = None
@@ -217,6 +223,8 @@ def fit(
     mesh=None,
     state_specs=None,
     supervision: Supervision | None = None,
+    state_pack: Callable | None = None,
+    state_unpack: Callable | None = None,
 ) -> FitResult:
     """Run ``step_fn(state, tokens, targets) -> (state, metrics)`` for
     ``cfg.num_steps`` total steps over ``dataset`` (an ``LMDataset``).
@@ -224,6 +232,16 @@ def fit(
     ``state['step']`` is the single source of truth for progress: batches
     are addressed by it, checkpoints are named by it, and resume reads it
     back.  Pass ``mesh``/``state_specs`` to restore sharded.
+
+    ``state_pack``/``state_unpack`` (optional) convert the live state to
+    and from its on-disk checkpoint layout: every save writes
+    ``state_pack(state)`` and every restore returns
+    ``state_unpack(loaded)``.  The ZeRO-1 sharded trainer wires
+    ``parallel.zero.make_consolidate_fn``/``make_reshard_fn`` here, so
+    its checkpoints are the replicated (world-size-independent) layout —
+    ``state_specs`` then describes the PACKED layout, since that is what
+    the restore reads.  A live shrink may swap both hooks via
+    ``Supervision.on_shrink``'s 5-tuple return.
 
     ``supervision`` (optional) arms the runtime-supervision layer — step
     watchdog, heartbeat membership with live shrink-to-survivors,
@@ -234,14 +252,19 @@ def fit(
     sup = supervision
     # mutable current-epoch execution context: live shrink swaps these
     cur_step_fn, cur_mesh, cur_specs = step_fn, mesh, state_specs
+    cur_pack, cur_unpack = state_pack, state_unpack
 
     def _fallback(bad_path, exc):
         report.ckpt_fallbacks += 1
 
     def _restore():
-        return restore_train_state(
+        loaded = restore_train_state(
             cfg.ckpt_dir, mesh=cur_mesh, specs=cur_specs, on_fallback=_fallback
         )
+        return cur_unpack(loaded) if cur_unpack is not None else loaded
+
+    def _packed(s):
+        return cur_pack(s) if cur_pack is not None else s
 
     resumed_from = 0
     if cfg.resume and cfg.ckpt_dir and latest_checkpoint(cfg.ckpt_dir):
@@ -326,7 +349,7 @@ def fit(
         def _shrink(at_step, new_dead):
             """Live shrink-to-survivors: drain, rebuild, restore, resume."""
             nonlocal state, world, shrinks, step, batches
-            nonlocal cur_step_fn, cur_mesh, cur_specs
+            nonlocal cur_step_fn, cur_mesh, cur_specs, cur_pack, cur_unpack
             from ..planner.choose import replan_for_survivors
 
             prev_world = world
@@ -342,16 +365,43 @@ def fit(
             # drain in-flight work: pending background saves first (the old
             # epoch's prefetcher is dropped below when batches reseek)
             _drained_saves(timeout=None)  # restore must never race a save
+            old_pack = cur_pack  # the OLD world's consolidator, pre-swap
             rebuilt = (
                 sup.on_shrink(n_alive, plan) if sup.on_shrink is not None else None
             )
             if rebuilt is not None:
-                cur_step_fn, cur_mesh, cur_specs = rebuilt
+                if len(rebuilt) == 5:
+                    # the re-shard path: the survivor world gets its own
+                    # checkpoint-layout converters (ZeRO state re-carved
+                    # from the consolidated checkpoint)
+                    (cur_step_fn, cur_mesh, cur_specs,
+                     cur_pack, cur_unpack) = rebuilt
+                else:
+                    cur_step_fn, cur_mesh, cur_specs = rebuilt
             if cfg.ckpt_dir and latest_checkpoint(cfg.ckpt_dir):
                 state = _restore()
                 step = int(np.asarray(jax.device_get(state["step"])))
                 log.warning(
                     "restored checkpointed step %d for the survivor world", step
+                )
+            elif old_pack is not None or cur_unpack is not None:
+                # no checkpoint yet, but the state layout is
+                # world-size-dependent (ZeRO shards): convert the LIVE
+                # state through the packed (world-independent) layout —
+                # the old world consolidates, the new world re-shards.
+                # The old mesh's devices are still alive in-process, so
+                # the old consolidator can run one last time.
+                packed = old_pack(state) if old_pack is not None else state
+                # host round-trip: the packed state lives on the OLD
+                # mesh's devices; the survivor world's converter places
+                # it fresh (exactly what a checkpoint restore would do)
+                packed = jax.device_get(packed)
+                state = (
+                    cur_unpack(packed) if cur_unpack is not None else packed
+                )
+                log.warning(
+                    "no checkpoint to restore: re-sharded the live state "
+                    "for the survivor world"
                 )
             world = n_alive
             shrinks += 1
@@ -423,7 +473,8 @@ def fit(
                         # IS a recent checkpoint; racing its rotation with
                         # a second writer would be worse than one lost step
                         save_train_state(
-                            cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep
+                            cfg.ckpt_dir, _packed(state),
+                            max_to_keep=cfg.max_to_keep,
                         )
                     report.preempted_at = step
                     log.warning(
@@ -515,17 +566,19 @@ def fit(
                 if sup is not None and sup.background_saver is not None:
                     # off-step-path save: the step loop never blocks on
                     # serialization + fsync, so ckpt_every can be small
-                    sup.background_saver.submit(state)
+                    # (the pack conversion, when set, runs on-path — it
+                    # is the consolidation collective, not the fsync)
+                    sup.background_saver.submit(_packed(state))
                 else:
                     save_train_state(
-                        cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep
+                        cfg.ckpt_dir, _packed(state), max_to_keep=cfg.max_to_keep
                     )
         # the preemption fast path already saved this exact state — a second
         # serialize+fsync would double the cost inside the grace window
         if cfg.ckpt_dir and step > start and report.preempted_at is None:
             if sup is None or _drained_saves():
                 save_train_state(
-                    cfg.ckpt_dir, state, max_to_keep=cfg.max_to_keep
+                    cfg.ckpt_dir, _packed(state), max_to_keep=cfg.max_to_keep
                 )
     finally:
         if sup is not None:

@@ -34,6 +34,7 @@ from .train import (
     resolve_axis_topos,
     sync_with_feedback,
     validate_tp,
+    zero_layout_for,
 )
 
 __all__ = [
@@ -45,15 +46,39 @@ __all__ = [
 ]
 
 
-def init_moe_train_state(key, cfg: MoEConfig, train_cfg=None) -> dict:
-    return make_train_state(init_moe_params(key, cfg), train_cfg)
+def init_moe_train_state(
+    key, cfg: MoEConfig, train_cfg=None, mesh=None,
+    axis_names: tuple[str, str, str, str] = ("dp", "ep", "sp", "tp"),
+) -> dict:
+    params = init_moe_params(key, cfg)
+    layout = None
+    if train_cfg is not None and train_cfg.shard_optimizer:
+        if mesh is None:
+            raise ValueError(
+                "shard_optimizer=True: init_moe_train_state needs mesh="
+            )
+        layout = zero_layout_for(
+            mesh, params,
+            moe_param_specs(cfg, axis_names[3], axis_names[1]), axis_names,
+        )
+    return make_train_state(params, train_cfg, layout=layout)
 
 
 def moe_state_specs(
     cfg: MoEConfig, tp_axis: str | None = "tp", ep_axis: str | None = "ep",
-    train_cfg=None,
+    train_cfg=None, mesh=None,
+    axis_names: tuple[str, str, str, str] = ("dp", "ep", "sp", "tp"),
 ) -> dict:
-    return make_state_specs(moe_param_specs(cfg, tp_axis, ep_axis), train_cfg)
+    pspecs = moe_param_specs(cfg, tp_axis, ep_axis)
+    layout = None
+    if train_cfg is not None and train_cfg.shard_optimizer:
+        if mesh is None:
+            raise ValueError("shard_optimizer=True: moe_state_specs needs mesh=")
+        shapes = jax.eval_shape(
+            lambda k: init_moe_params(k, cfg), jax.random.PRNGKey(0)
+        )
+        layout = zero_layout_for(mesh, shapes, pspecs, axis_names)
+    return make_state_specs(pspecs, train_cfg, layout=layout)
 
 
 def factor_devices_moe(n: int) -> tuple[int, int, int, int]:
@@ -104,12 +129,20 @@ def make_moe_train_step(
         mesh, model_cfg, train_cfg, axis_names, init_fn=init_moe_params
     )
 
-    sspecs = moe_state_specs(model_cfg, tp, ep, train_cfg)
+    sspecs = moe_state_specs(
+        model_cfg, tp, ep, train_cfg, mesh=mesh, axis_names=axis_names
+    )
     data_spec = P((dp, ep), sp)
     mesh_axes = axis_names
     n_devices = 1
     for a in mesh_axes:
         n_devices *= mesh.shape[a]
+    zero_layout = None
+    if train_cfg.shard_optimizer:
+        shapes = jax.eval_shape(
+            lambda k: init_moe_params(k, model_cfg), jax.random.PRNGKey(0)
+        )
+        zero_layout = zero_layout_for(mesh, shapes, sspecs["params"], axis_names)
 
     def device_step(state, tokens, targets):
         # tp-fold redundancy only: dp/ep/sp partition the data
@@ -129,7 +162,7 @@ def make_moe_train_step(
                 state, tokens, targets, model_cfg, train_cfg,
                 sspecs["params"], mesh_axes, topos, n_total_tokens,
                 n_devices, tp_axis=tp, sp_axis=sp, ep_axis=ep,
-                serialize=serialize_overlap,
+                serialize=serialize_overlap, zero_layout=zero_layout,
             )
         else:
 
@@ -149,9 +182,12 @@ def make_moe_train_step(
             (_, (ce, aux)), grads = jax.value_and_grad(
                 local_loss, has_aux=True
             )(state["params"])
-            grads, new_ef = sync_with_feedback(
-                state, grads, sspecs["params"], mesh_axes, topos, train_cfg
-            )
+            if not train_cfg.shard_optimizer:
+                grads, new_ef = sync_with_feedback(
+                    state, grads, sspecs["params"], mesh_axes, topos, train_cfg
+                )
+            else:
+                new_ef = None  # the zero path carries EF itself
 
         global_ce = ce
         global_aux = aux / n_devices
@@ -164,6 +200,29 @@ def make_moe_train_step(
             "aux": global_aux,
             "total": global_ce + model_cfg.router_aux_weight * global_aux,
         }
+        if train_cfg.shard_optimizer:
+            from .zero import (
+                maybe_clip_shards,
+                zero_apply_and_gather,
+                zero_sync_and_update,
+            )
+
+            if train_cfg.overlap:
+                shard_tree = maybe_clip_shards(
+                    grads, sspecs["params"], train_cfg, zero_layout, metrics
+                )
+                new_state = zero_apply_and_gather(
+                    state, shard_tree, sspecs["params"], mesh_axes, topos,
+                    train_cfg, zero_layout,
+                )
+                if new_ef is not None:
+                    new_state["ef"] = new_ef
+            else:
+                new_state = zero_sync_and_update(
+                    state, grads, sspecs["params"], mesh_axes, topos,
+                    train_cfg, zero_layout, metrics,
+                )
+            return new_state, metrics
         grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
         new_state = adamw_apply(state, grads, train_cfg)
         if new_ef is not None:

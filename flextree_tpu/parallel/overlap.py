@@ -235,7 +235,7 @@ def plan_overlap(
 
 def _sync_fired_bucket(
     bucket_tree, bucket_specs, mesh_axes, topos, train_cfg, step, ef_tree,
-    name: str,
+    name: str, zero_layout=None,
 ):
     """Sync one fired bucket with the exact ``sync_with_feedback``
     semantics: identity codec -> plain bitwise sync, residual None; lossy
@@ -252,6 +252,32 @@ def _sync_fired_bucket(
     from .train import _sync_codec, sync_grads
 
     codec = _sync_codec(train_cfg)
+    if zero_layout is not None:
+        # ZeRO composition: the fired bucket REDUCE-SCATTERS at readiness
+        # (wire-compressed; EF semantics identical) — the optimizer shard
+        # update and the parameter all-gather run post-backward, per
+        # bucket, in zero_apply_and_gather.  The fired subtree builds its
+        # own leaf-local layout (a pure function of shape+spec, so it
+        # cannot disagree with the step's global ZeroLayout).
+        from .zero import zero_reduce_scatter_grads
+
+        with comm_span(name):
+            if not codec.lossy:
+                return (
+                    zero_reduce_scatter_grads(
+                        bucket_tree, bucket_specs, mesh_axes, topos,
+                        bucket_bytes=train_cfg.bucket_bytes,
+                    ),
+                    None,
+                )
+            v = jax.tree.map(
+                lambda g, e: g + e.astype(g.dtype), bucket_tree, ef_tree
+            )
+            return zero_reduce_scatter_grads(
+                v, bucket_specs, mesh_axes, topos,
+                bucket_bytes=train_cfg.bucket_bytes,
+                codec=codec, step=step, return_residual=True,
+            )
     with comm_span(name):
         if not codec.lossy:
             return (
@@ -286,6 +312,7 @@ def _fire_boundaries(
     seg_index: int,
     synced_out: dict,
     ef_out: dict,
+    zero_layout=None,
 ):
     """Fire every bucket whose closing segment is ``seg_index``: merge its
     segments into one tree, sync, scatter results back by path."""
@@ -301,7 +328,8 @@ def _fire_boundaries(
     nbytes = sum(plan.seg_bytes[i] for i in bucket)
     name = f"ft_overlap_bucket{bi}_{plan.labels[bucket[0]]}_{nbytes}B"
     synced, res = _sync_fired_bucket(
-        tree, specs, mesh_axes, topos, train_cfg, state["step"], ef, name
+        tree, specs, mesh_axes, topos, train_cfg, state["step"], ef, name,
+        zero_layout=zero_layout,
     )
     for i in bucket:
         synced_out[i] = synced[str(i)]
@@ -335,12 +363,15 @@ def _run_overlap_engine(
     seg_paths,
     backward_segments: Callable[[], Sequence],
     serialize: bool,
+    zero_layout=None,
 ):
     """Shared core of the dense/MoE engines: walk ``backward_segments()``
     (a generator yielding each segment's raw grads in readiness order),
     firing closed buckets as segments become ready — or, serialized, after
     an ``optimization_barrier`` over every gradient (the full-backward
-    barrier; same buckets, same order, bitwise-equal results)."""
+    barrier; same buckets, same order, bitwise-equal results).  With a
+    ``zero_layout`` the fired collective is the ZeRO reduce-scatter and
+    the returned "grads" tree carries per-leaf ``ZeroShard``s."""
     fire_at = {b[-1]: bi for bi, b in enumerate(plan.boundaries)}
     n_seg = len(seg_paths)
     seg_grads: list = [None] * n_seg
@@ -358,6 +389,7 @@ def _run_overlap_engine(
             _fire_boundaries(
                 plan, seg_paths, seg_grads, state, pspecs, mesh_axes, topos,
                 train_cfg, fire_at, i, synced, ef_out,
+                zero_layout=zero_layout,
             )
     else:
         for i, g in enumerate(backward_segments()):
@@ -365,6 +397,7 @@ def _run_overlap_engine(
             _fire_boundaries(
                 plan, seg_paths, seg_grads, state, pspecs, mesh_axes, topos,
                 train_cfg, fire_at, i, synced, ef_out,
+                zero_layout=zero_layout,
             )
 
     grads = _assemble(params, seg_paths, [synced[i] for i in range(n_seg)])
@@ -387,10 +420,13 @@ def dense_overlap_step_grads(
     tp_axis,
     sp_axis,
     serialize: bool = False,
+    zero_layout=None,
 ):
     """Loss + readiness-order-synced grads (+ EF residuals) for the dense
     train step — the overlap twin of ``value_and_grad(local_loss)`` +
     ``sync_with_feedback``, bitwise-identical for the identity codec.
+    With ``zero_layout`` each fired bucket reduce-scatters instead
+    (ZeRO-1 composition) and the grads tree carries ``ZeroShard``s.
 
     Collective-context function (call inside ``shard_map``).  Returns
     ``(loss, synced_grads, new_ef_or_None)``.
@@ -447,7 +483,7 @@ def dense_overlap_step_grads(
 
     grads, new_ef = _run_overlap_engine(
         state, params, pspecs, mesh_axes, topos, train_cfg, plan, seg_paths,
-        backward_segments, serialize,
+        backward_segments, serialize, zero_layout=zero_layout,
     )
     return loss, grads, new_ef
 
@@ -467,6 +503,7 @@ def moe_overlap_step_grads(
     sp_axis,
     ep_axis,
     serialize: bool = False,
+    zero_layout=None,
 ):
     """MoE twin of :func:`dense_overlap_step_grads`: per-layer segments
     carry an auxiliary router-balance output whose cotangent is the
@@ -551,7 +588,7 @@ def moe_overlap_step_grads(
 
     grads, new_ef = _run_overlap_engine(
         state, params, pspecs, mesh_axes, topos, train_cfg, plan, seg_paths,
-        backward_segments, serialize,
+        backward_segments, serialize, zero_layout=zero_layout,
     )
     return ce, aux_mean, grads, new_ef
 

@@ -60,6 +60,7 @@ from .train import (
     spread_factors,
     sync_with_feedback,
     validate_tp,
+    zero_layout_for,
 )
 
 __all__ = [
@@ -110,17 +111,43 @@ def pipeline_param_specs(
     return {"embed": P(None, None), "ln_f": P(None), "layers": stacked}
 
 
-def init_pipeline_train_state(key, cfg: TransformerConfig, train_cfg=None) -> dict:
-    return make_train_state(stack_layer_params(init_params(key, cfg)), train_cfg)
+def init_pipeline_train_state(
+    key, cfg: TransformerConfig, train_cfg=None, mesh=None,
+    axis_names: tuple[str, str, str, str] = ("dp", "pp", "sp", "tp"),
+) -> dict:
+    params = stack_layer_params(init_params(key, cfg))
+    layout = None
+    if train_cfg is not None and train_cfg.shard_optimizer:
+        if mesh is None:
+            raise ValueError(
+                "shard_optimizer=True: init_pipeline_train_state needs mesh="
+            )
+        layout = zero_layout_for(
+            mesh, params,
+            pipeline_param_specs(cfg, axis_names[1], axis_names[3]),
+            axis_names,
+        )
+    return make_train_state(params, train_cfg, layout=layout)
 
 
 def pipeline_state_specs(
     cfg: TransformerConfig, pp_axis: str | None = "pp", tp_axis: str | None = "tp",
-    train_cfg=None,
+    train_cfg=None, mesh=None,
+    axis_names: tuple[str, str, str, str] = ("dp", "pp", "sp", "tp"),
 ) -> dict:
-    return make_state_specs(
-        pipeline_param_specs(cfg, pp_axis, tp_axis), train_cfg
-    )
+    pspecs = pipeline_param_specs(cfg, pp_axis, tp_axis)
+    layout = None
+    if train_cfg is not None and train_cfg.shard_optimizer:
+        if mesh is None:
+            raise ValueError(
+                "shard_optimizer=True: pipeline_state_specs needs mesh="
+            )
+        shapes = jax.eval_shape(
+            lambda k: stack_layer_params(init_params(k, cfg)),
+            jax.random.PRNGKey(0),
+        )
+        layout = zero_layout_for(mesh, shapes, pspecs, axis_names)
+    return make_state_specs(pspecs, train_cfg, layout=layout)
 
 
 # ------------------------------------------------------------- mesh helper
@@ -259,9 +286,18 @@ def make_pipeline_train_step(
         init_fn=lambda k, cfg: stack_layer_params(init_params(k, cfg)),
     )
 
-    sspecs = pipeline_state_specs(model_cfg, pp, tp, train_cfg)
+    sspecs = pipeline_state_specs(
+        model_cfg, pp, tp, train_cfg, mesh=mesh, axis_names=axis_names
+    )
     data_spec = P(dp, sp)
     mesh_axes = axis_names
+    zero_layout = None
+    if train_cfg.shard_optimizer:
+        shapes = jax.eval_shape(
+            lambda k: stack_layer_params(init_params(k, model_cfg)),
+            jax.random.PRNGKey(0),
+        )
+        zero_layout = zero_layout_for(mesh, shapes, sspecs["params"], axis_names)
 
     def device_step(state, tokens, targets):
         b_local, t_local = tokens.shape
@@ -293,6 +329,26 @@ def make_pipeline_train_step(
         loss, grads = jax.value_and_grad(local_loss)(state["params"])
 
         topos = resolve_axis_topos(mesh, mesh_axes, train_cfg.grad_topo)
+        if train_cfg.shard_optimizer:
+            # ZeRO path: the scan transpose already emits every gradient
+            # at once (the GPipe dataflow barrier — docs/OVERLAP.md), and
+            # the sharded sync fires per bucket with each bucket
+            # data-dependent only on its own leaves, so the post-backward
+            # bubble scheduling the overlap path buys is structural here;
+            # the overlap/serialize flags are no-ops for the sharded
+            # pipeline step.
+            from .zero import zero_sync_and_update
+
+            global_loss = loss
+            for ax in mesh_axes:
+                global_loss = lax.psum(global_loss, ax)
+            metrics = {"loss": global_loss}
+            new_state = zero_sync_and_update(
+                state, grads, sspecs["params"], mesh_axes, topos, train_cfg,
+                zero_layout, metrics,
+            )
+            return new_state, metrics
+
         if train_cfg.overlap:
             from .overlap import overlap_sync_with_feedback
 

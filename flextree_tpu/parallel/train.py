@@ -136,6 +136,21 @@ class TrainConfig:
     # EF/codec semantics carried through unchanged.  False (default) is
     # the historical serialized path, byte-for-byte.
     overlap: bool = False
+    # ZeRO-1 sharded-optimizer path (parallel/zero.py, docs/SHARDED.md):
+    # optimizer state (and, for lossy codecs, the f32 master param copy)
+    # shards over each leaf's FIRST replication axis; the step
+    # reduce-scatters gradients (wire-compressed under ``codec``), applies
+    # AdamW on the owned shard only, and all-gathers updated parameters
+    # per bucket.  Per-rank mu/nu memory drops by the shard-axis size;
+    # the quantized sharded step moves ~wire_ratio x the bytes of the
+    # replicated fused f32 sync (BOTH phases ride the codec).  For the
+    # identity codec the step is BITWISE-equal to the replicated step
+    # across flat/tree/ring shard topologies (lonely shapes fall back to
+    # the flat tree for the sharded collectives).  Composes with
+    # ``overlap`` (per-bucket reduce-scatter fires at grad readiness; the
+    # parameter all-gathers overlap the remaining per-bucket optimizer
+    # work).  State init/specs need the mesh (init_train_state(mesh=...)).
+    shard_optimizer: bool = False
 
 
 def prime_factors(n: int) -> list[int]:
@@ -196,20 +211,42 @@ def make_mesh_3d(
     return make_mesh_nd(n_devices, shape, axis_names)
 
 
-def make_train_state(params, train_cfg: "TrainConfig | None" = None) -> dict:
+def make_train_state(
+    params, train_cfg: "TrainConfig | None" = None, *, layout=None
+) -> dict:
     """Fresh AdamW state around a parameter pytree (any layout).
 
     A lossy gradient-sync codec (``train_cfg.codec``) adds the
     error-feedback residual tree ``"ef"`` (zeros, param-shaped): each step
     syncs ``grad + ef`` and stores what the wire's input quantization lost
     back into ``ef``, so no gradient mass is ever dropped — only delayed.
+
+    ``train_cfg.shard_optimizer`` replaces the full ``mu``/``nu`` trees
+    with the sharded layout of ``parallel.zero`` (owned head block +
+    replicated tail per leaf, plus the f32 master shards for lossy
+    codecs) — pass the :class:`~flextree_tpu.parallel.zero.ZeroLayout`
+    built for the mesh (``zero_layout_for`` / ``init_train_state(mesh=)``).
     """
-    state = {
-        "params": params,
-        "mu": jax.tree.map(jnp.zeros_like, params),
-        "nu": jax.tree.map(jnp.zeros_like, params),
-        "step": jnp.zeros((), jnp.int32),
-    }
+    sharded = train_cfg is not None and train_cfg.shard_optimizer
+    if sharded:
+        from .zero import init_zero_entries
+
+        if layout is None:
+            raise ValueError(
+                "shard_optimizer=True needs the mesh's ZeroLayout — call "
+                "init_train_state(..., mesh=mesh) or pass layout="
+            )
+        state = {"params": params, "step": jnp.zeros((), jnp.int32)}
+        state.update(
+            init_zero_entries(params, layout, _sync_codec(train_cfg).lossy)
+        )
+    else:
+        state = {
+            "params": params,
+            "mu": jax.tree.map(jnp.zeros_like, params),
+            "nu": jax.tree.map(jnp.zeros_like, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
     if train_cfg is not None and _sync_codec(train_cfg).lossy:
         state["ef"] = jax.tree.map(jnp.zeros_like, params)
     return state
@@ -234,16 +271,52 @@ def validate_tp(model_cfg: TransformerConfig, tp_size: int) -> None:
         )
 
 
+def zero_layout_for(mesh: Mesh, params_shapes, pspecs, axis_names):
+    """The mesh's :class:`~flextree_tpu.parallel.zero.ZeroLayout` for a
+    parameter tree — shared by state init, spec building and the step
+    builders so the three can never disagree on who owns which block."""
+    from .zero import build_zero_layout
+
+    axis_sizes = {ax: int(mesh.shape[ax]) for ax in axis_names}
+    return build_zero_layout(params_shapes, pspecs, tuple(axis_names), axis_sizes)
+
+
 def init_train_state(
-    key, cfg: TransformerConfig, train_cfg: "TrainConfig | None" = None
+    key,
+    cfg: TransformerConfig,
+    train_cfg: "TrainConfig | None" = None,
+    mesh: Mesh | None = None,
+    axis_names: tuple[str, str, str] = ("dp", "sp", "tp"),
 ) -> dict:
-    return make_train_state(init_params(key, cfg), train_cfg)
+    params = init_params(key, cfg)
+    layout = None
+    if train_cfg is not None and train_cfg.shard_optimizer:
+        if mesh is None:
+            raise ValueError("shard_optimizer=True: init_train_state needs mesh=")
+        layout = zero_layout_for(
+            mesh, params, param_specs(cfg, axis_names[-1]), axis_names
+        )
+    return make_train_state(params, train_cfg, layout=layout)
 
 
-def make_state_specs(pspecs, train_cfg: "TrainConfig | None" = None) -> dict:
+def make_state_specs(
+    pspecs, train_cfg: "TrainConfig | None" = None, *, layout=None
+) -> dict:
     """Optimizer-state specs around parameter specs (moments shard alike;
-    the error-feedback residual of a lossy sync codec shards alike too)."""
-    specs = {"params": pspecs, "mu": pspecs, "nu": pspecs, "step": P()}
+    the error-feedback residual of a lossy sync codec shards alike too).
+    Under ``shard_optimizer`` the moment specs come from the
+    ``ZeroLayout`` instead (owned blocks ``P(shard_ax)``, tails ``P()``)."""
+    if train_cfg is not None and train_cfg.shard_optimizer:
+        from .zero import zero_state_specs
+
+        if layout is None:
+            raise ValueError("shard_optimizer=True needs layout= for specs")
+        specs = {"params": pspecs, "step": P()}
+        specs.update(
+            zero_state_specs(pspecs, layout, _sync_codec(train_cfg).lossy)
+        )
+    else:
+        specs = {"params": pspecs, "mu": pspecs, "nu": pspecs, "step": P()}
     if train_cfg is not None and _sync_codec(train_cfg).lossy:
         specs["ef"] = pspecs
     return specs
@@ -253,8 +326,19 @@ def state_specs(
     cfg: TransformerConfig,
     tp_axis: str | None = "tp",
     train_cfg: "TrainConfig | None" = None,
+    mesh: Mesh | None = None,
+    axis_names: tuple[str, str, str] = ("dp", "sp", "tp"),
 ) -> dict:
-    return make_state_specs(param_specs(cfg, tp_axis), train_cfg)
+    pspecs = param_specs(cfg, tp_axis)
+    layout = None
+    if train_cfg is not None and train_cfg.shard_optimizer:
+        if mesh is None:
+            raise ValueError("shard_optimizer=True: state_specs needs mesh=")
+        shapes = jax.eval_shape(
+            lambda k: init_params(k, cfg), jax.random.PRNGKey(0)
+        )
+        layout = zero_layout_for(mesh, shapes, pspecs, axis_names)
+    return make_state_specs(pspecs, train_cfg, layout=layout)
 
 
 def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
@@ -427,6 +511,7 @@ def maybe_autotune_grad_topo(
         plan = autotune_plan(
             n, nbytes, dtype="float32", codecs=(train_cfg.codec,), top_k=3,
             repeat=3, overlap=train_cfg.overlap,
+            sharded=train_cfg.shard_optimizer,
         )
         spec[ax] = plan.to_ft_topo()
     return dataclasses.replace(train_cfg, grad_topo=spec, autotune=False)
@@ -574,9 +659,19 @@ def make_train_step(
         mesh, model_cfg, train_cfg, axis_names
     )
 
-    sspecs = state_specs(model_cfg, tp, train_cfg)
+    sspecs = state_specs(
+        model_cfg, tp, train_cfg, mesh=mesh, axis_names=axis_names
+    )
     data_spec = P(dp, sp)
     mesh_axes = axis_names
+    zero_layout = None
+    if train_cfg.shard_optimizer:
+        shapes = jax.eval_shape(
+            lambda k: init_params(k, model_cfg), jax.random.PRNGKey(0)
+        )
+        zero_layout = zero_layout_for(
+            mesh, shapes, sspecs["params"], axis_names
+        )
 
     def device_step(state, tokens, targets):
         n_total_tokens = (
@@ -594,6 +689,7 @@ def make_train_step(
                 state, tokens, targets, model_cfg, train_cfg,
                 sspecs["params"], mesh_axes, topos, n_total_tokens,
                 tp_axis=tp, sp_axis=sp, serialize=serialize_overlap,
+                zero_layout=zero_layout,
             )
         else:
 
@@ -605,16 +701,44 @@ def make_train_step(
                 return loss_sum / n_total_tokens
 
             loss, grads = jax.value_and_grad(local_loss)(state["params"])
-            grads, new_ef = sync_with_feedback(
-                state, grads, sspecs["params"], mesh_axes, topos, train_cfg
-            )
+            if not train_cfg.shard_optimizer:
+                grads, new_ef = sync_with_feedback(
+                    state, grads, sspecs["params"], mesh_axes, topos, train_cfg
+                )
+            else:
+                new_ef = None  # the zero path carries EF itself
         global_loss = lax.psum(lax.psum(lax.psum(loss, dp), sp), tp)
 
         metrics = {"loss": global_loss}
-        grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
-        new_state = adamw_apply(state, grads, train_cfg)
-        if new_ef is not None:
-            new_state["ef"] = new_ef
+        if train_cfg.shard_optimizer:
+            from .zero import (
+                maybe_clip_shards,
+                zero_apply_and_gather,
+                zero_sync_and_update,
+            )
+
+            if train_cfg.overlap:
+                # the engine already reduce-scattered per fired bucket;
+                # grads is a tree of ZeroShard (and new_ef the residuals)
+                shard_tree = maybe_clip_shards(
+                    grads, sspecs["params"], train_cfg, zero_layout, metrics
+                )
+                new_state = zero_apply_and_gather(
+                    state, shard_tree, sspecs["params"], mesh_axes, topos,
+                    train_cfg, zero_layout,
+                )
+                if new_ef is not None:
+                    new_state["ef"] = new_ef
+            else:
+                new_state = zero_sync_and_update(
+                    state, grads, sspecs["params"], mesh_axes, topos,
+                    train_cfg, zero_layout, metrics,
+                )
+        else:
+            grads = maybe_clip_grads(grads, sspecs["params"], train_cfg, metrics)
+            new_state = adamw_apply(state, grads, train_cfg)
+            if new_ef is not None:
+                new_state["ef"] = new_ef
         return new_state, metrics
 
     mspec = metric_specs(train_cfg, {"loss": P()})

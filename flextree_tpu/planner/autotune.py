@@ -92,15 +92,21 @@ def analytic_shortlist(
     codecs=DEFAULT_CODECS,
     params=None,
     top_k: int = 4,
+    sharded: bool = False,
 ) -> list[tuple[tuple[int, ...], int, str, float]]:
     """Top-K ``(widths, lonely, codec, predicted_us)`` over the shape x
     codec product, cheapest first.  The overall analytic argmin is rank 0
-    by construction."""
+    by construction.  ``sharded`` prices one ZeRO sync round (grad
+    reduce-scatter + param all-gather — ``choose_topology(collective=
+    "sharded")``) instead of the fused allreduce."""
     if params is None:
         params = default_params()
     rows: list[tuple[tuple[int, ...], int, str, float]] = []
     for codec in codecs:
-        plan = choose_topology(n, nbytes, params=params, codec=codec)
+        plan = choose_topology(
+            n, nbytes, params=params, codec=codec,
+            collective="sharded" if sharded else "allreduce",
+        )
         for c in plan.candidates:
             rows.append((c.widths, c.lonely, codec, c.total_us))
     rows.sort(key=lambda r: r[3])
@@ -152,12 +158,14 @@ def _cache_store(path, doc) -> None:
 # ------------------------------------------------------------ measure
 
 
-def _default_timer(candidates, n, nbytes, dtype, repeat):
+def _default_timer(candidates, n, nbytes, dtype, repeat, sharded: bool = False):
     """Measure every candidate with the bench harness's shuffled-
     interleaved protocol (one warmed jitted fn per candidate, reps
     interleaved in shuffled rounds so a host-contention episode cannot
     land on one candidate — the BENCH_ALLREDUCE r03/r04 lesson).
     Returns measured seconds per candidate, aligned with ``candidates``.
+    ``sharded`` times the split round the ZeRO step actually runs
+    (``all_gather(reduce_scatter(x))`` with the codec on both wires).
     """
     import jax
     import jax.numpy as jnp
@@ -165,6 +173,7 @@ def _default_timer(candidates, n, nbytes, dtype, repeat):
     from jax.sharding import PartitionSpec as P
 
     from ..bench.harness import _interleaved_times
+    from ..parallel.allreduce import all_gather, reduce_scatter
     from ..parallel.compressed import compressed_allreduce
     from ..parallel.mesh import flat_mesh
 
@@ -180,6 +189,11 @@ def _default_timer(candidates, n, nbytes, dtype, repeat):
         spec = ",".join(map(str, widths)) + (f"+{lonely}" if lonely else "")
 
         def device_fn(row, spec=spec, codec=codec):
+            if sharded:
+                shard = reduce_scatter(row[0], "ft", topo=spec, codec=codec)
+                return all_gather(
+                    shard, "ft", topo=spec, out_shape=row[0].shape, codec=codec
+                )[None]
             return compressed_allreduce(row[0], "ft", topo=spec, codec=codec)[None]
 
         fn = jax.jit(
@@ -210,6 +224,7 @@ def autotune_plan(
     repeat: int = 5,
     use_cache: bool = True,
     overlap: bool = False,
+    sharded: bool = False,
 ) -> TunedPlan:
     """Pick the gradient-sync plan by measurement.
 
@@ -229,13 +244,22 @@ def autotune_plan(
     collectives mid-backward, where the best shape can differ (smaller
     latency-bound buckets win when comm hides under compute).  The
     shortlist and measurement protocol are shared; only the key differs.
+
+    ``sharded`` switches both the analytic costing AND the measured
+    protocol to the ZeRO split round (grad reduce-scatter + param
+    all-gather), and grows the cache key with a sharding component —
+    sharded and replicated plans never alias (same rule as overlap, new
+    guard in ``tests/test_sharded.py``).
     """
     codecs = tuple(codecs)
-    shortlist = analytic_shortlist(n, nbytes, codecs, params=params, top_k=top_k)
+    shortlist = analytic_shortlist(
+        n, nbytes, codecs, params=params, top_k=top_k, sharded=sharded
+    )
     fp = backend_fingerprint()
     key = plan_cache_key(
         fp, f"n{n}", f"{nbytes}B", dtype, ",".join(codecs),
         "overlap" if overlap else "serial",
+        "sharded" if sharded else "replicated",
     )
     path = _cache_path(cache_path)
 
@@ -252,7 +276,8 @@ def autotune_plan(
             )
 
     if timer is None:
-        timer = _default_timer
+        def timer(c, n_, nb, dt, rep, _sharded=sharded):
+            return _default_timer(c, n_, nb, dt, rep, sharded=_sharded)
     measured_s = timer(shortlist, n, nbytes, dtype, repeat)
     if len(measured_s) != len(shortlist):
         raise ValueError(

@@ -52,7 +52,11 @@ __all__ = [
 #: Schema version written into every calibration section (and every
 #: autotune plan-cache entry).  Bump when the on-disk format changes;
 #: loaders refuse sections from a NEWER schema rather than misparse them.
-CALIBRATION_SCHEMA = 2
+#: Schema 3 adds the split-collective per-phase bandwidth scales
+#: (``rs_bw_scale``/``ag_bw_scale``, arXiv:2409.04202's two-halves
+#: costing); schema-2 sections still load, with the neutral defaults and
+#: a logged notice (never silently).
+CALIBRATION_SCHEMA = 3
 
 
 def backend_fingerprint() -> str | None:
@@ -277,10 +281,23 @@ def _params_to_dict(p: TpuCostParams) -> dict:
         "launch_us": p.launch_us,
         "codec_bw_GBps": p.codec_bw_GBps,
         "bwd_GFLOPs": p.bwd_GFLOPs,
+        "rs_bw_scale": p.rs_bw_scale,
+        "ag_bw_scale": p.ag_bw_scale,
     }
 
 
 def _params_from_dict(d: dict) -> TpuCostParams:
+    if "rs_bw_scale" not in d or "ag_bw_scale" not in d:
+        # pre-schema-3 section: the split-collective per-phase scales were
+        # not measured — load with the neutral 1.0 (the fused costing),
+        # and say so rather than defaulting silently
+        from ..utils.logging import get_logger
+
+        get_logger("flextree.planner").info(
+            "calibration section predates the split-collective constants "
+            "(schema < 3); rs_bw_scale/ag_bw_scale default to 1.0 — "
+            "re-run tools/calibrate_host.py to measure them"
+        )
     return TpuCostParams(
         ici=LinkParams(d["ici_bandwidth_GBps"], d["ici_latency_us"]),
         dcn=LinkParams(d["dcn_bandwidth_GBps"], d["dcn_latency_us"]),
@@ -292,6 +309,8 @@ def _params_from_dict(d: dict) -> TpuCostParams:
         # files written before the overlap planner lack the backward-compute
         # constant: 0.0 keeps the backend-resolved default in force
         bwd_GFLOPs=d.get("bwd_GFLOPs", TpuCostParams.bwd_GFLOPs),
+        rs_bw_scale=d.get("rs_bw_scale", TpuCostParams.rs_bw_scale),
+        ag_bw_scale=d.get("ag_bw_scale", TpuCostParams.ag_bw_scale),
     )
 
 

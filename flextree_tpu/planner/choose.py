@@ -32,8 +32,11 @@ from ..schedule.stages import LonelyTopology, Topology
 from .cost_model import (
     CostBreakdown,
     TpuCostParams,
+    all_gather_cost,
     allreduce_cost,
     lonely_allreduce_cost,
+    reduce_scatter_cost,
+    sharded_sync_cost,
 )
 from .factorize import is_prime, ordered_factorizations
 
@@ -149,6 +152,7 @@ def choose_topology(
     mesh_shape: tuple[int, ...] | None = None,
     dcn_axes: tuple[int, ...] = (),
     codec=None,
+    collective: str = "allreduce",
 ) -> Plan:
     """Pick the cheapest topology for ``n`` devices and ``nbytes``/chip.
 
@@ -165,9 +169,28 @@ def choose_topology(
     costing exactly.  The codec x shape product is searched by
     ``planner.autotune.autotune_plan``, which measures the analytic
     shortlist instead of trusting it.
+
+    ``collective`` selects what is being planned: ``"allreduce"`` (the
+    default, historical behavior), ``"reduce_scatter"`` / ``"all_gather"``
+    (one phase alone, per-phase bandwidth scales applied), or
+    ``"sharded"`` — one ZeRO-1 sync round (quantized grad reduce-scatter
+    + quantized param all-gather, ``cost_model.sharded_sync_cost``).
+    Split collectives have no lonely candidates (lonely ranks own no
+    block — the runtime falls back to the flat tree there too).
     """
     if n < 1:
         raise ValueError(f"n must be >= 1, got {n}")
+    if collective not in ("allreduce", "reduce_scatter", "all_gather", "sharded"):
+        raise ValueError(f"unknown collective {collective!r}")
+
+    def cost_fn(topo, dcn_stages=()):
+        if collective == "allreduce":
+            return allreduce_cost(topo, nbytes, params, dcn_stages=dcn_stages, codec=codec)
+        if collective == "reduce_scatter":
+            return reduce_scatter_cost(topo, nbytes, params, dcn_stages=dcn_stages, codec=codec)
+        if collective == "all_gather":
+            return all_gather_cost(topo, nbytes, params, dcn_stages=dcn_stages, codec=codec)
+        return sharded_sync_cost(topo, nbytes, params, dcn_stages=dcn_stages, codec=codec)
     if params is None:
         # measured constants from $FLEXTREE_CALIBRATION when present
         # (per-backend CALIBRATION.json, see planner/calibrate.py), else
@@ -197,11 +220,16 @@ def choose_topology(
     cands: list[Candidate] = []
     for widths in candidate_topologies(n):
         if widths == (1,):
-            from .cost_model import ring_cost
+            if collective == "allreduce":
+                from .cost_model import ring_cost
 
-            cost = ring_cost(
-                n, nbytes, params, crosses_dcn=bool(dcn_axes), codec=codec
-            )
+                cost = ring_cost(
+                    n, nbytes, params, crosses_dcn=bool(dcn_axes), codec=codec
+                )
+            else:
+                cost = cost_fn(
+                    Topology.ring(n), dcn_stages=(0,) if dcn_axes else ()
+                )
             cands.append(Candidate((1,), cost, False))
             continue
         topo = Topology(n, widths)
@@ -220,13 +248,11 @@ def choose_topology(
                 # (pessimistic) so misaligned shapes can't win on an
                 # optimistic ICI-only estimate
                 dcn_stages = tuple(range(len(widths)))
-        cost = allreduce_cost(
-            topo, nbytes, params, dcn_stages=dcn_stages, codec=codec
-        )
+        cost = cost_fn(topo, dcn_stages=dcn_stages)
         cands.append(Candidate(widths, cost, aligned))
 
     advisory: tuple[str, ...] = ()
-    if is_prime(n) and n > 3:
+    if is_prime(n) and n > 3 and collective == "allreduce":
         # Prime N: the reference could only *advise* resizing to N±1
         # (ChooseWidth.h:16-21; its runtime aborts on product != N).  Our
         # runtime executes lonely shapes (schedule.stages.LonelyTopology),
@@ -281,6 +307,7 @@ def choose_bucket_bytes(
     params: TpuCostParams | None = None,
     max_buckets: int = 64,
     codec=None,
+    sharded: bool = False,
 ) -> int:
     """Cost-model-driven gradient-bucket size: the fused-sync bucket cap
     that minimizes predicted sync time for ``nbytes`` of gradients.
@@ -326,15 +353,39 @@ def choose_bucket_bytes(
             return lonely_allreduce_cost(t.tree, t.lonely, nb, params, codec=codec)
         return allreduce_cost(t, nb, params, codec=codec)
 
+    def sharded_cost(nb):
+        # the ZeRO split schedule per bucket: grad reduce-scatter + param
+        # all-gather on the FIRST (shard) topology, shard-sized allreduce
+        # on the rest — cost_model.sharded_sync_cost prices exactly the
+        # collectives zero_sync_and_update issues
+        first = topo_list[0]
+        shard_topo = (
+            Topology.flat(first.num_nodes)
+            if isinstance(first, LonelyTopology)
+            else first
+        )
+        return sharded_sync_cost(
+            shard_topo, nb, params, codec=codec,
+            secondary_topos=tuple(
+                Topology.flat(t.num_nodes) if isinstance(t, LonelyTopology) else t
+                for t in topo_list[1:]
+            ),
+        )
+
     fixed = byte_us = 0.0
-    for t in topo_list:
-        fixed += cost(t, 0).total_us
-        full = cost(t, nbytes)
-        # codec_us is byte-proportional (encode/decode passes), so a
-        # compressed sync amortizes it across buckets exactly like
-        # bandwidth — the argmin shifts toward fewer, larger buckets as
-        # the wire gets cheaper relative to the fixed launch cost
-        byte_us += full.bandwidth_us + full.reduce_us + full.codec_us
+    if sharded:
+        fixed = sharded_cost(0).total_us
+        full = sharded_cost(nbytes)
+        byte_us = full.bandwidth_us + full.reduce_us + full.codec_us
+    else:
+        for t in topo_list:
+            fixed += cost(t, 0).total_us
+            full = cost(t, nbytes)
+            # codec_us is byte-proportional (encode/decode passes), so a
+            # compressed sync amortizes it across buckets exactly like
+            # bandwidth — the argmin shifts toward fewer, larger buckets as
+            # the wire gets cheaper relative to the fixed launch cost
+            byte_us += full.bandwidth_us + full.reduce_us + full.codec_us
     k_max = max(1, min(max_buckets, n_leaves or max_buckets))
     best_k, best_t = 1, float("inf")
     for k in range(1, k_max + 1):

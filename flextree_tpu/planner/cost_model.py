@@ -38,6 +38,9 @@ __all__ = [
     "allreduce_cost",
     "lonely_allreduce_cost",
     "ring_cost",
+    "reduce_scatter_cost",
+    "all_gather_cost",
+    "sharded_sync_cost",
 ]
 
 
@@ -87,6 +90,17 @@ class TpuCostParams:
     # (parallel/overlap.py: a CPU host is GFLOP/s-scale, an accelerator
     # TFLOP/s-scale); calibratable like every other constant.
     bwd_GFLOPs: float = 0.0
+    # split-collective bandwidth scales ("Revisiting the Time Cost Model of
+    # AllReduce", arXiv:2409.04202: the two halves of an allreduce do NOT
+    # share one α-β term — the reduce-scatter's critical path carries the
+    # fold arithmetic while the allgather is pure forwarding, so their
+    # achieved bandwidths differ and a fused fit mis-ranks split
+    # schedules).  Achieved-bandwidth multipliers on the link term: 1.0
+    # (the default) reproduces the fused costing exactly; calibration can
+    # set them per backend (CALIBRATION_SCHEMA 3 round-trips both; older
+    # files load with the neutral defaults, non-silently).
+    rs_bw_scale: float = 1.0
+    ag_bw_scale: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -249,6 +263,121 @@ def ring_cost(
             params.codec_bw_GBps * 1e3
         )
     return CostBreakdown(lat, bw, red, 0.0, cod)
+
+
+# ---------------------------------------------------------------------------
+# split-collective costs (PR 7): the two phases priced separately
+# ---------------------------------------------------------------------------
+
+
+def _phase_cost(
+    topo: Topology,
+    nbytes: int,
+    params: TpuCostParams,
+    phase: str,  # "rs" | "ag"
+    dcn_stages: tuple[int, ...] = (),
+    codec=None,
+) -> CostBreakdown:
+    """One phase of the tree/ring schedule: ``reduce_scatter_us`` /
+    ``all_gather_us`` as arXiv:2409.04202 argues they should be costed —
+    per-phase achieved bandwidth (``rs_bw_scale``/``ag_bw_scale``), the
+    fold arithmetic charged to phase 1 only, and the codec term split the
+    way ``parallel/compressed.py`` actually spends it (per-stage re-encode
+    on the accumulation path vs encode-once + forward + one decode)."""
+    ratio, hop_cost = _codec_props(codec)
+    scale = params.rs_bw_scale if phase == "rs" else params.ag_bw_scale
+    cbw = params.codec_bw_GBps * 1e3
+    if topo.is_ring:
+        n = topo.num_nodes
+        if n <= 1:
+            return CostBreakdown(0.0, 0.0, 0.0, 0.0)
+        link = params.dcn if dcn_stages else params.ici
+        steps = n - 1
+        per_step = nbytes / n
+        lat = steps * (link.latency_us + params.launch_us)
+        bw = steps * link.time_us(per_step * ratio) / max(scale, 1e-9)
+        red = (n - 1) / n * nbytes / (params.reduce_bw_GBps * 1e3) if phase == "rs" else 0.0
+        cod = 0.0
+        if hop_cost:
+            cod = (
+                2 * steps * per_step / cbw
+                if phase == "rs"
+                else (per_step + nbytes) / cbw
+            )
+        return CostBreakdown(lat, bw, red, 0.0, cod)
+    links = _stage_links(topo, params, dcn_stages)
+    lat = bw = red = ctl = cod = 0.0
+    for i, w in enumerate(topo.widths):
+        g = topo.gaps[i]
+        link = links[i]
+        stage_bytes = (w - 1) / w * (nbytes / g)
+        hops = w - 1
+        lat += hops * link.latency_us + params.launch_us
+        bw += link.time_us(stage_bytes * ratio) / max(scale, 1e-9)
+        ctl += params.control_us_per_width * max(0, w - 2)
+        if phase == "rs":
+            red += stage_bytes / (params.reduce_bw_GBps * 1e3)
+            if hop_cost:
+                cod += 2 * (nbytes / g) / cbw
+    if phase == "ag" and hop_cost:
+        cod += (nbytes / topo.num_nodes + nbytes) / cbw
+    return CostBreakdown(lat, bw, red, ctl, cod)
+
+
+def reduce_scatter_cost(
+    topo: Topology,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    dcn_stages: tuple[int, ...] = (),
+    codec=None,
+) -> CostBreakdown:
+    """Predicted wall time of phase 1 alone (``reduce_scatter_us``):
+    ``nbytes``/chip in, a 1/N owned shard out.  With the neutral
+    per-phase scales, ``reduce_scatter_cost + all_gather_cost`` matches
+    :func:`allreduce_cost` term for term."""
+    return _phase_cost(topo, nbytes, params, "rs", dcn_stages, codec)
+
+
+def all_gather_cost(
+    topo: Topology,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    dcn_stages: tuple[int, ...] = (),
+    codec=None,
+) -> CostBreakdown:
+    """Predicted wall time of phase 2 alone (``all_gather_us``): 1/N
+    shards in, the full ``nbytes`` buffer out on every chip."""
+    return _phase_cost(topo, nbytes, params, "ag", dcn_stages, codec)
+
+
+def sharded_sync_cost(
+    topo: Topology,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    dcn_stages: tuple[int, ...] = (),
+    codec=None,
+    secondary_topos: tuple = (),
+) -> CostBreakdown:
+    """One ZeRO-1 sharded sync round on the shard axis: quantized gradient
+    reduce-scatter down + quantized parameter all-gather up (same byte
+    profile per phase; the codec pays on BOTH wires), plus a shard-sized
+    allreduce per secondary replication topology."""
+    rs = _phase_cost(topo, nbytes, params, "rs", dcn_stages, codec)
+    ag = _phase_cost(topo, nbytes, params, "ag", dcn_stages, codec)
+    lat = rs.latency_us + ag.latency_us
+    bw = rs.bandwidth_us + ag.bandwidth_us
+    red = rs.reduce_us + ag.reduce_us
+    ctl = rs.control_us + ag.control_us
+    cod = rs.codec_us + ag.codec_us
+    shard_bytes = nbytes / max(topo.num_nodes, 1)
+    for t2 in secondary_topos:
+        sec = allreduce_cost(t2, shard_bytes, params, codec=codec)
+        lat += sec.latency_us
+        bw += sec.bandwidth_us
+        red += sec.reduce_us
+        ctl += sec.control_us
+        cod += sec.codec_us
+    return CostBreakdown(lat, bw, red, ctl, cod)
 
 
 def bus_bandwidth_GBps(n: int, nbytes: int, time_us: float) -> float:

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-__all__ = ["BlockLayout"]
+__all__ = ["BlockLayout", "owned_block", "shard_layout"]
 
 
 @dataclass(frozen=True)
@@ -69,3 +69,61 @@ class BlockLayout:
     def slices(self) -> list[slice]:
         """Python slices for every block, clamped to the unpadded buffer."""
         return [slice(s, s + l) for s, l in (self.span(b) for b in range(self.num_nodes))]
+
+
+# ---------------------------------------------------------------------------
+# shard-layout contract (PR 7): which block each rank OWNS after phase 1
+# ---------------------------------------------------------------------------
+#
+# The standalone ``reduce_scatter`` leaves every rank holding exactly one
+# fully-reduced 1/N block of the (padded) buffer; ``all_gather`` reassembles
+# the blocks in BLOCK order.  Which block a rank owns is a pure function of
+# the width vector — the residue-chain ownership of SURVEY §3.2:
+#
+# - **tree** (widths ``(w0, .., wk)``): stage ``i`` splits the current slice
+#   into ``wi`` tiles and the rank at group position ``p_i = (r // gap_i) %
+#   wi`` keeps tile ``p_i`` (``lax.psum_scatter(tiled=True)`` ownership), so
+#   the final block index is the mixed-radix composition
+#   ``sum_i p_i * prod(widths[i+1:])``.  Flat ``(N,)`` degenerates to
+#   ``owned_block(r) == r``.
+# - **ring** (sentinel ``(1,)``): after ``N-1`` fold steps of the reference
+#   block walk (send ``(r - s) % N``, fold ``(r - s - 1) % N``), rank ``r``
+#   holds the fully-reduced block ``(r + 1) % N`` (``mpi_mod.hpp:1149``:
+#   the gather phase starts by forwarding exactly that block).
+# - **lonely** (``m`` tree ranks + ``l`` lonely): only tree ranks own
+#   blocks; lonely rank ``m + i`` MIRRORS its buddy ``i``'s block (the
+#   reduce-scatter ships the buddy's reduced tile over, so both hold
+#   identical bits).  The ``l`` mirrored blocks are duplicates, not a
+#   partition — ``all_gather`` ignores the lonely ranks' copies.
+#
+# This module is imported by the JAX-less static verifier, so everything
+# here must stay pure Python.
+
+
+def owned_block(topo, rank: int) -> int:
+    """Block index rank ``rank`` owns after a standalone reduce-scatter
+    with ``topo`` (a resolved ``Topology`` or ``LonelyTopology``)."""
+    n = topo.num_nodes
+    if not 0 <= rank < n:
+        raise IndexError(f"rank {rank} out of range [0, {n})")
+    if hasattr(topo, "tree"):  # LonelyTopology: buddies mirror
+        m = topo.tree.num_nodes
+        return owned_block(topo.tree, rank if rank < m else rank - m)
+    if topo.is_ring:
+        return (rank + 1) % n
+    block = 0
+    for i, w in enumerate(topo.widths):
+        tiles_below = 1
+        for wj in topo.widths[i + 1:]:
+            tiles_below *= wj
+        p = (rank // topo.gaps[i]) % w
+        block += p * tiles_below
+    return block
+
+
+def shard_layout(topo) -> tuple[int, ...]:
+    """Owned block per rank: ``shard_layout(topo)[r] == owned_block(topo,
+    r)``.  For tree/ring shapes this is a permutation of ``range(N)``; for
+    lonely shapes the last ``l`` entries duplicate their buddies' blocks
+    and the first ``m`` entries form the true partition."""
+    return tuple(owned_block(topo, r) for r in range(topo.num_nodes))

@@ -21,7 +21,11 @@ import argparse
 
 
 def build(args):
-    """(state, step_fn, mesh, state_specs) for the chosen model family."""
+    """(state, step_fn, mesh, restore_specs, state_pack, state_unpack)
+    for the chosen model family.  ``restore_specs`` describes the
+    CHECKPOINT layout (for sharded runs that is the consolidated
+    replicated layout; ``state_pack``/``state_unpack`` convert — None for
+    replicated runs)."""
     import jax
 
     from .models.transformer import TransformerConfig
@@ -38,6 +42,7 @@ def build(args):
         codec=args.codec,
         autotune=args.autotune,
         overlap=args.overlap,
+        shard_optimizer=args.shard_optimizer,
     )
     key = jax.random.PRNGKey(args.seed)
     mesh_shape = (
@@ -53,42 +58,103 @@ def build(args):
         sp_impl=args.sp_impl,
         attn_impl=args.attn_impl,
     )
+    def sharded_hooks(mesh, pspecs, params_shapes, axis_names, sspecs, tc):
+        """(state_specs_for_restore, pack, unpack) for the run: sharded
+        runs checkpoint CONSOLIDATED (world-size-independent), so the
+        restore specs are the replicated layout and pack/unpack are the
+        on-device converters (docs/SHARDED.md).  ``tc`` must be the
+        RESOLVED config (autotune already pinned into ``grad_topo``) —
+        the converters' shard-block permutation has to match the step's.
+        """
+        if not tc.shard_optimizer:
+            return sspecs, None, None
+        import dataclasses as _dc
+
+        from .parallel.train import _sync_codec, make_state_specs, zero_layout_for
+        from .parallel.zero import make_consolidate_fn, make_reshard_fn
+
+        layout = zero_layout_for(mesh, params_shapes, pspecs, axis_names)
+        lossy = _sync_codec(tc).lossy
+        packed_specs = make_state_specs(
+            pspecs, _dc.replace(tc, shard_optimizer=False)
+        )
+        pack = make_consolidate_fn(mesh, pspecs, layout, tc.grad_topo, lossy)
+        unpack = make_reshard_fn(mesh, pspecs, layout, tc.grad_topo, lossy)
+        return packed_specs, pack, unpack
+
     if args.model == "dense":
+        from .models.transformer import init_params, param_specs
         from .parallel.train import (
             init_train_state,
             make_mesh_3d,
             make_train_step,
+            maybe_autotune_grad_topo,
             state_specs,
         )
 
         cfg = TransformerConfig(**common)
         mesh = make_mesh_3d(args.devices, mesh_shape)
+        axis_names = ("dp", "sp", "tp")
+        # resolve autotune NOW so the checkpoint converters below see the
+        # same grad_topo the step will run (make_train_step re-resolves —
+        # a no-op after this: autotune=False and the plan cache hits)
+        tc = maybe_autotune_grad_topo(mesh, cfg, tc, axis_names)
+        sspecs = state_specs(cfg, train_cfg=tc, mesh=mesh)
+        params_shapes = jax.eval_shape(
+            lambda k: init_params(k, cfg), jax.random.PRNGKey(0)
+        )
+        restore_specs, pack, unpack = sharded_hooks(
+            mesh, param_specs(cfg, "tp"), params_shapes, axis_names, sspecs, tc
+        )
         return (
-            init_train_state(key, cfg, tc),
+            init_train_state(key, cfg, tc, mesh=mesh),
             make_train_step(mesh, cfg, tc),
             mesh,
-            state_specs(cfg, train_cfg=tc),
+            restore_specs,
+            pack,
+            unpack,
         )
     if args.model == "pipeline":
+        from .models.transformer import init_params
         from .parallel.pipeline import (
             init_pipeline_train_state,
             make_mesh_4d,
             make_pipeline_train_step,
+            pipeline_param_specs,
             pipeline_state_specs,
+            stack_layer_params,
         )
 
         cfg = TransformerConfig(**common)
         mesh = make_mesh_4d(args.devices, mesh_shape)
+        axis_names = ("dp", "pp", "sp", "tp")
+        from .parallel.train import maybe_autotune_grad_topo
+
+        tc = maybe_autotune_grad_topo(
+            mesh, cfg, tc, axis_names,
+            init_fn=lambda k, c: stack_layer_params(init_params(k, c)),
+        )
+        sspecs = pipeline_state_specs(cfg, train_cfg=tc, mesh=mesh)
+        params_shapes = jax.eval_shape(
+            lambda k: stack_layer_params(init_params(k, cfg)),
+            jax.random.PRNGKey(0),
+        )
+        restore_specs, pack, unpack = sharded_hooks(
+            mesh, pipeline_param_specs(cfg), params_shapes, axis_names, sspecs,
+            tc,
+        )
         return (
-            init_pipeline_train_state(key, cfg, tc),
+            init_pipeline_train_state(key, cfg, tc, mesh=mesh),
             make_pipeline_train_step(
                 mesh, cfg, tc, n_microbatches=args.microbatches
             ),
             mesh,
-            pipeline_state_specs(cfg, train_cfg=tc),
+            restore_specs,
+            pack,
+            unpack,
         )
     if args.model == "moe":
-        from .models.moe import MoEConfig
+        from .models.moe import MoEConfig, init_moe_params, moe_param_specs
         from .parallel.moe_train import (
             init_moe_train_state,
             make_mesh_moe,
@@ -103,11 +169,26 @@ def build(args):
             capacity_factor=args.capacity_factor,
         )
         mesh = make_mesh_moe(args.devices, mesh_shape)
+        axis_names = ("dp", "ep", "sp", "tp")
+        from .parallel.train import maybe_autotune_grad_topo
+
+        tc = maybe_autotune_grad_topo(
+            mesh, cfg, tc, axis_names, init_fn=init_moe_params
+        )
+        sspecs = moe_state_specs(cfg, train_cfg=tc, mesh=mesh)
+        params_shapes = jax.eval_shape(
+            lambda k: init_moe_params(k, cfg), jax.random.PRNGKey(0)
+        )
+        restore_specs, pack, unpack = sharded_hooks(
+            mesh, moe_param_specs(cfg), params_shapes, axis_names, sspecs, tc
+        )
         return (
-            init_moe_train_state(key, cfg, tc),
+            init_moe_train_state(key, cfg, tc, mesh=mesh),
             make_moe_train_step(mesh, cfg, tc),
             mesh,
-            moe_state_specs(cfg, train_cfg=tc),
+            restore_specs,
+            pack,
+            unpack,
         )
     raise ValueError(f"unknown model {args.model!r}")
 
@@ -166,6 +247,18 @@ def main(argv=None) -> int:
         "of trusting the cost-model argmin; cached under "
         "FLEXTREE_PLAN_CACHE so the next run is a pure cache hit "
         "(overlapped and serialized plans never share a cache entry)",
+    )
+    ap.add_argument(
+        "--shard-optimizer", action="store_true",
+        help="ZeRO-1 sharded-optimizer path (docs/SHARDED.md): shard "
+        "optimizer state (and the f32 master copy for lossy codecs) over "
+        "each leaf's first replication axis; the step reduce-scatters "
+        "grads (wire-compressed under --codec), updates the owned shard "
+        "only, and all-gathers updated params per bucket. Per-rank mu/nu "
+        "memory drops by the shard-axis size; bitwise-identical to the "
+        "replicated step for the f32 codec. Checkpoints are written "
+        "CONSOLIDATED (world-size-independent), so elastic shrink "
+        "re-shards them onto the survivors",
     )
     ap.add_argument(
         "--overlap", action=argparse.BooleanOptionalAction, default=False,
@@ -250,7 +343,7 @@ def main(argv=None) -> int:
             preemption=PreemptionGuard().install() if want_preempt else None,
         )
 
-    state, step_fn, mesh, sspecs = build(args)
+    state, step_fn, mesh, sspecs, state_pack, state_unpack = build(args)
     dataset = LMDataset(
         synthetic_tokens(args.corpus_tokens, args.vocab, seed=args.seed),
         batch=args.batch,
@@ -272,6 +365,8 @@ def main(argv=None) -> int:
             mesh=mesh,
             state_specs=sspecs,
             supervision=supervision,
+            state_pack=state_pack,
+            state_unpack=state_unpack,
         )
     finally:
         if supervision is not None and supervision.preemption is not None:

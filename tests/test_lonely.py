@@ -174,19 +174,33 @@ def test_validator_accepts_lonely():
     assert stats.p2p_messages == tree_stats.p2p_messages + 2
 
 
-def test_phase_apis_reject_lonely_clearly():
+def test_phase_apis_lonely_mirror_contract():
+    """The split phases support lonely shapes since PR 7: the head splits
+    over the m TREE ranks and each lonely rank ends holding a bitwise
+    COPY of its buddy's owned block (the mirror contract of
+    ``schedule.blocks.owned_block``)."""
+    import numpy as np
+
     from flextree_tpu.parallel import reduce_scatter
     from flextree_tpu.parallel.mesh import flat_mesh
+    from flextree_tpu.schedule.blocks import shard_layout
     from jax.sharding import PartitionSpec as P
 
     mesh = flat_mesh(7, "ft")
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((7, 12)).astype(np.float32)  # 12 = 2 per block
 
     def body(row):
         return reduce_scatter(row[0], "ft", topo="3,2+1")[None]
 
     f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("ft"), out_specs=P("ft")))
-    with pytest.raises(TopologyError, match="lonely"):
-        f(jnp.ones((7, 14)))
+    out = np.asarray(f(jnp.asarray(data)))
+    blocks = data.sum(0).reshape(6, 2)
+    lay = shard_layout(Topology.resolve(7, "3,2+1"))
+    for r in range(7):
+        np.testing.assert_allclose(out[r], blocks[lay[r]], rtol=1e-5, atol=1e-5)
+    # the mirror is bitwise: lonely rank 6 holds exactly buddy 0's shard
+    assert out[6].tobytes() == out[0].tobytes()
 
 
 def test_lonely_cost_dcn_buddy_pricing():

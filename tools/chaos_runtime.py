@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import signal
 import subprocess
@@ -52,7 +53,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-SCENARIOS = ("sigkill", "sigstop", "sigterm")
+SCENARIOS = ("sigkill", "sigstop", "sigterm", "sigkill_sharded")
 
 # supervision budgets (seconds) — every scenario derives its waits from
 # these, so the asserts below are "within the lease budget" by construction
@@ -160,6 +161,164 @@ def child_train() -> int:
         "report": result.report.to_payload(),
         "shrink_calls": shrl,
         "ckpt_steps": [s for s, _ in list_checkpoints(ckpt_dir)],
+    }
+    print("CHAOS_JSON: " + json.dumps(payload), flush=True)
+    return 0
+
+
+def child_train_sharded() -> int:
+    """The supervised SHARDED training process (PR 7): a real jitted
+    ZeRO-1 dense step over a dp-wide virtual-CPU mesh whose width mirrors
+    the heartbeat world.  Checkpoints are CONSOLIDATED (world-size
+    independent); on shrink the survivors rebuild the step on the
+    narrower mesh and re-partition the full CRC-verified checkpoint into
+    their new owned shards (``zero.make_reshard_fn``)."""
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flextree_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(4)
+    import numpy as np
+
+    from flextree_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+        param_specs,
+    )
+    from flextree_tpu.parallel.loop import FitConfig, Supervision, fit
+    from flextree_tpu.parallel.train import (
+        TrainConfig,
+        init_train_state,
+        make_mesh_nd,
+        make_state_specs,
+        make_train_step,
+        zero_layout_for,
+    )
+    from flextree_tpu.parallel.zero import make_consolidate_fn, make_reshard_fn
+    from flextree_tpu.runtime import (
+        MembershipView,
+        PreemptionGuard,
+        Supervisor,
+        SupervisorConfig,
+    )
+
+    hb_dir = os.environ["FT_HB_DIR"]
+    world = int(os.environ["FT_WORLD"])
+    steps = int(os.environ["FT_STEPS"])
+    ckpt_dir = os.environ["FT_CKPT_DIR"]
+    step_sleep = float(os.environ.get("FT_STEP_SLEEP", str(STEP_SLEEP)))
+
+    model_cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64
+    )
+    axes = ("dp", "sp", "tp")
+    base_tc = TrainConfig(shard_optimizer=True)
+
+    def build_world(ndev, grad_topo=None):
+        tc = dataclasses.replace(base_tc, grad_topo=grad_topo)
+        mesh = make_mesh_nd(ndev, (ndev, 1, 1), axes)
+        jit_step = make_train_step(mesh, model_cfg, tc)
+
+        def step_fn(state, tokens, targets):
+            time.sleep(step_sleep)  # give the supervision layer wall-time
+            return jit_step(state, tokens, targets)
+
+        pspecs = param_specs(model_cfg, "tp")
+        shapes = jax.eval_shape(
+            lambda k: init_params(k, model_cfg), jax.random.PRNGKey(0)
+        )
+        layout = zero_layout_for(mesh, shapes, pspecs, axes)
+        packed_specs = make_state_specs(
+            pspecs, dataclasses.replace(tc, shard_optimizer=False)
+        )
+        pack = make_consolidate_fn(mesh, pspecs, layout, grad_topo, False)
+        unpack = make_reshard_fn(mesh, pspecs, layout, grad_topo, False)
+        return mesh, step_fn, packed_specs, pack, unpack
+
+    mesh, step_fn, packed_specs, pack, unpack = build_world(world)
+    cur = {"pack": pack, "unpack": unpack}
+
+    class _LMData:
+        def batch_at(self, step):
+            tok = (np.arange(6 * 16, dtype=np.int32).reshape(6, 16) + step) % 64
+            return tok, tok
+
+    cfg_hb = SupervisorConfig(
+        rank=0, dir=hb_dir, interval_s=HB_INTERVAL,
+        straggler_s=STRAGGLER_S, lease_s=LEASE_S,
+    )
+    supervisor = Supervisor(cfg_hb)
+    supervisor.beat_now()
+    barrier_view = MembershipView.for_config(cfg_hb, configured=world)
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        if all(s.step >= 0 for s in barrier_view.poll().values()):
+            break
+        time.sleep(0.05)
+    else:
+        print("FAIL: peers never assembled for supervision", flush=True)
+        return 1
+
+    shrl: list = []
+
+    def on_shrink(n_alive, plan):
+        mesh2, step2, specs2, pack2, unpack2 = build_world(
+            n_alive, grad_topo=plan.to_ft_topo()
+        )
+        cur["pack"], cur["unpack"] = pack2, unpack2
+        shrl.append({"alive": n_alive, "topo": plan.to_ft_topo()})
+        return step2, mesh2, specs2, pack2, unpack2
+
+    supervision = Supervision(
+        supervisor=supervisor,
+        membership=MembershipView.for_config(cfg_hb, configured=world),
+        configured_world=world,
+        step_timeout_s=60.0,
+        on_shrink=on_shrink,
+        nbytes_hint=1 << 16,
+        preemption=PreemptionGuard().install(),
+    )
+
+    state = init_train_state(
+        jax.random.PRNGKey(0), model_cfg, base_tc, mesh=mesh
+    )
+    result = fit(
+        state, step_fn, _LMData(),
+        FitConfig(
+            num_steps=steps, ckpt_dir=ckpt_dir,
+            ckpt_every=int(os.environ.get("FT_CKPT_EVERY", "4")),
+            log_every=10, prefetch=0,
+        ),
+        mesh=mesh, state_specs=packed_specs, supervision=supervision,
+        state_pack=pack, state_unpack=unpack,
+    )
+    # the consistency proof: consolidate the final sharded state, then
+    # re-shard and re-consolidate — a consistent re-shard is a bitwise
+    # fixed point, and every leaf must be finite
+    cons = cur["pack"](result.state)
+    roundtrip = cur["pack"](cur["unpack"](cons))
+    flat_a = jax.tree.leaves(cons)
+    flat_b = jax.tree.leaves(roundtrip)
+    consistent = all(
+        np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        for a, b in zip(flat_a, flat_b)
+    )
+    finite = all(np.isfinite(np.asarray(l)).all() for l in flat_a)
+    from flextree_tpu.utils.checkpoint import list_checkpoints
+
+    payload = {
+        "final_step": int(np.asarray(jax.device_get(result.state["step"]))),
+        "steps_run": result.steps_run,
+        "resumed_from": result.resumed_from,
+        "report": result.report.to_payload(),
+        "shrink_calls": shrl,
+        "ckpt_steps": [s for s, _ in list_checkpoints(ckpt_dir)],
+        "reshard_consistent": bool(consistent),
+        "state_finite": bool(finite),
+        "losses": [float(l) for _, l in result.losses],
     }
     print("CHAOS_JSON: " + json.dumps(payload), flush=True)
     return 0
@@ -286,6 +445,74 @@ def run_sigkill(workdir: str) -> dict:
     return {
         "scenario": "sigkill",
         "injection": "SIGKILL of peer rank 2 mid-run",
+        "recovered": recovered,
+        "checks": checks,
+        "log": log.splitlines(),
+    }
+
+
+def run_sigkill_sharded(workdir: str) -> dict:
+    """Mid-run SIGKILL of a peer under a REAL jitted ZeRO-1 sharded step
+    (PR 7): the trainer holds sharded optimizer state over a dp-3 mesh
+    and checkpoints CONSOLIDATED; the shrink rebuilds on a dp-2 mesh,
+    restores the full checkpoint and re-partitions it into the survivor
+    world's owned shards.  Asserted: the 3 → 2 epoch with a replanned
+    topo, the run completing with finite losses, and the re-shard being a
+    bitwise fixed point (consolidate ∘ reshard ∘ consolidate)."""
+    hb = os.path.join(workdir, "hb")
+    ck = os.path.join(workdir, "ck")
+    steps = 40
+    trainer = _spawn(
+        "train_sharded", hb, ck,
+        {"FT_WORLD": "3", "FT_STEPS": str(steps), "FT_CKPT_EVERY": "4"},
+    )
+    peers = [
+        _spawn("peer", hb, ck, {"FT_RANK": str(r), "FT_PEER_SECONDS": "90"})
+        for r in (1, 2)
+    ]
+    checks: dict = {}
+    try:
+        kill_at = _wait_for_step(hb, 0, 8, timeout=120.0)
+        os.kill(peers[1].pid, signal.SIGKILL)
+        checks["killed_at_trainer_step"] = kill_at
+        log, rc = _finish(trainer, timeout=300)
+    finally:
+        for p in (trainer, *peers):
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        peer_rcs = [p.returncode for p in peers]
+    payload = _chaos_payload(log) or {}
+    report = payload.get("report", {})
+    epochs = report.get("membership_epochs", [])
+    losses = payload.get("losses", [])
+    checks.update(
+        trainer_rc=rc,
+        epochs=epochs,
+        shrink_calls=payload.get("shrink_calls"),
+        final_step=payload.get("final_step"),
+        reshard_consistent=payload.get("reshard_consistent"),
+        state_finite=payload.get("state_finite"),
+        peer_rcs=peer_rcs,
+    )
+    recovered = (
+        rc == 0
+        and payload.get("final_step") == steps
+        and len(epochs) == 2
+        and epochs[0]["alive"] == 3
+        and epochs[1]["alive"] == 2
+        and epochs[1]["dead"] == [2]
+        and epochs[1]["topo"] is not None
+        and payload.get("reshard_consistent") is True
+        and payload.get("state_finite") is True
+        and bool(losses)
+        and all(math.isfinite(l) for l in losses)
+    )
+    return {
+        "scenario": "sigkill_sharded",
+        "injection": "SIGKILL of peer rank 2 under a live ZeRO-1 sharded "
+                     "jitted run (dp-3 mesh -> dp-2 re-shard from the "
+                     "consolidated checkpoint)",
         "recovered": recovered,
         "checks": checks,
         "log": log.splitlines(),
@@ -424,11 +651,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.child:
         role = os.environ.get("FT_CHAOS_ROLE", "train")
-        return child_train() if role == "train" else child_peer()
+        if role == "train":
+            return child_train()
+        if role == "train_sharded":
+            return child_train_sharded()
+        return child_peer()
 
     which = tuple(args.scenario) if args.scenario else SCENARIOS
     runners = {
-        "sigkill": run_sigkill, "sigstop": run_sigstop, "sigterm": run_sigterm
+        "sigkill": run_sigkill, "sigstop": run_sigstop,
+        "sigterm": run_sigterm, "sigkill_sharded": run_sigkill_sharded,
     }
     results = []
     for name in which:
