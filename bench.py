@@ -354,8 +354,12 @@ def bench_tpu_kernel_guarded(timeout_s: int = 3300) -> dict | None:
 
 
 def run_static_analysis_tripwire(timeout_s: int = 120) -> dict:
-    """Supplementary key ``analysis_violations`` — the static verifier's
-    verdict on this exact tree (ISSUE 3 tripwire; 0 = clean).
+    """Supplementary keys ``analysis_violations`` — the static verifier's
+    verdict on this exact tree (ISSUE 3 tripwire; 0 = clean) — and
+    ``ir_equivalence_violations`` (ISSUE 8): the lowered StableHLO of
+    every IR-compiled collective matches its IR stage list
+    (count/kind/group-width per stage); any divergence between the
+    verified schedule object and the executable is a non-zero count.
 
     Runs the full CLI (``flextree_tpu.analysis``) in a subprocess: it
     pins its own 8-vdev CPU mesh (safe regardless of this process's
@@ -378,7 +382,15 @@ def run_static_analysis_tripwire(timeout_s: int = 120) -> dict:
         )
         with open(report_path, encoding="utf-8") as fh:
             report = json.load(fh)
-        out = {"analysis_violations": report["analysis_violations"]}
+        out = {
+            "analysis_violations": report["analysis_violations"],
+            # KeyError (layer missing = pass didn't run) falls through to
+            # the except arm: the key stays ABSENT, which reads as "not
+            # verified", never as "clean"
+            "ir_equivalence_violations": report["layers"]["ir_equivalence"][
+                "violations"
+            ],
+        }
         if not report["mutation_selftest"]["all_caught"]:
             out["analysis_error"] = "mutation self-test escaped"
         elif p.returncode != 0 and report["analysis_violations"] == 0:

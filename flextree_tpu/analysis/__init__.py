@@ -1,13 +1,18 @@
 """FlexTree static verifier: ahead-of-time analysis of generated programs.
 
-Three layers, one report:
+Three layers (plus the IR-equivalence pass), one report:
 
-1. :mod:`.schedule_check` — model-check generated message programs
-   (tree/ring/lonely × chunked): deadlock-freedom under blocking
-   rendezvous, chunk conservation, peer symmetry, chunk-buffer overlap.
+1. :mod:`.schedule_check` — model-check generated message programs for
+   every schedule family (tree/ring/lonely/swing/generalized × chunked):
+   deadlock-freedom under blocking rendezvous, chunk conservation, peer
+   symmetry, chunk-buffer overlap.  Every program is expanded from the
+   declarative schedule IR (``schedule/ir.py``) by
+   :func:`~.schedule_check.program_from_ir` — the same object
+   ``compile_ir`` lowers, so checker and executable cannot drift.
 2. :mod:`.hlo_lint` — lower the jitted entrypoints and lint the StableHLO
    against declared collective budgets, dtype, host-transfer, and
-   donation contracts.
+   donation contracts; :mod:`.ir_equivalence` additionally certifies each
+   IR-compiled collective's StableHLO sequence matches its IR stage list.
 3. :mod:`.jit_hygiene` — AST lint over the library source for
    wall-clock/RNG calls inside jitted code, Python branching on traced
    values, and missing ``static_argnames``.
@@ -15,22 +20,29 @@ Three layers, one report:
 The suite is self-distrusting: :mod:`.mutation` seeds known corruption
 classes and asserts each is caught — a checker that passes everything is
 a failing test.  CLI: ``python -m flextree_tpu.analysis --report
-ANALYSIS.json``; CI gate: ``tools/run_static_checks.py``.
+ANALYSIS.json`` (``--programs`` filters the matrices); CI gate:
+``tools/run_static_checks.py --staleness-gate``.
 """
 
 from .base import Violation, violations_to_json
 from .schedule_check import (
     build_program,
+    check_ir,
+    check_ir_families,
     check_program,
     check_schedule,
     check_standard_schedules,
+    program_from_ir,
 )
 
 __all__ = [
     "Violation",
     "violations_to_json",
     "build_program",
+    "check_ir",
+    "check_ir_families",
     "check_program",
     "check_schedule",
     "check_standard_schedules",
+    "program_from_ir",
 ]

@@ -7,6 +7,15 @@ violations AND every seeded corruption class is caught by its layer.
 ``--skip-hlo`` runs only the JAX-less layers (schedule model checker +
 jit hygiene) for environments without a usable backend; the committed
 report is always produced by a full run.
+
+``--programs SUBSTR [SUBSTR ...]`` filters the schedule / split-phase /
+IR-family / ir-equivalence matrices to rows whose name contains any of
+the substrings — the growing matrix stays debuggable one program at a
+time.  The report carries per-program wall-times (``program_times``) so
+a row creeping toward the 60 s budget is visible in the artifact, not
+just in CI duration graphs.  (Both the filter flag and the timing block
+are excluded from the CI staleness comparison —
+``tools/run_static_checks.py`` strips the volatile keys.)
 """
 
 from __future__ import annotations
@@ -32,36 +41,43 @@ def _configure_cpu_mesh() -> None:
         pass  # backends already up (e.g. under pytest): use what exists
 
 
-def build_report(include_hlo: bool = True) -> dict:
+def build_report(include_hlo: bool = True, programs=None) -> dict:
+    """One report from the SAME library loops the tests and gates call —
+    ``programs``/``times`` are hooks on those functions, never a second
+    copy of their matrix logic (the drift class this PR exists to kill)."""
     from ..schedule.analysis import traffic_summary
     from ..schedule.stages import Topology
     from .base import violations_to_json
     from .jit_hygiene import run_jit_hygiene
     from .mutation import run_mutation_selftest
-    from .schedule_check import check_split_schedules, check_standard_schedules
+    from .schedule_check import (
+        check_ir_families,
+        check_split_schedules,
+        check_standard_schedules,
+    )
 
     t0 = time.perf_counter()
     report: dict = {"layers": {}}
+    times: dict = {}
     violations = []
 
-    sched_v, programs = check_standard_schedules()
-    violations += sched_v
-    report["layers"]["schedule_check"] = {
-        "programs_checked": programs,
-        "violations": len(sched_v),
-    }
-
-    # standalone reduce-scatter / all-gather programs (PR 7): conservation
-    # proves each rank ends with exactly its owned block / the full vector
-    split_v, split_programs = check_split_schedules()
-    violations += split_v
-    report["layers"]["split_schedule_check"] = {
-        "programs_checked": split_programs,
-        "violations": len(split_v),
-    }
+    for layer, fn in (
+        ("schedule_check", check_standard_schedules),
+        ("split_schedule_check", check_split_schedules),
+        ("ir_check", check_ir_families),
+    ):
+        layer_times: dict = {}
+        vs, checked = fn(programs=programs, times=layer_times)
+        violations += vs
+        report["layers"][layer] = {
+            "programs_checked": checked,
+            "violations": len(vs),
+        }
+        times[layer] = layer_times
 
     if include_hlo:
         from .hlo_lint import run_hlo_lint
+        from .ir_equivalence import run_ir_equivalence
 
         hlo_v, hlo_detail = run_hlo_lint(full=True)
         violations += hlo_v
@@ -69,6 +85,17 @@ def build_report(include_hlo: bool = True) -> dict:
             "entrypoints": hlo_detail,
             "violations": len(hlo_v),
         }
+
+        # ir_equivalence: the lowered StableHLO's collective sequence
+        # must match the IR stage list (count/kind/width/pairs/bytes)
+        eq_times: dict = {}
+        eq_v, eq_detail = run_ir_equivalence(programs=programs, times=eq_times)
+        violations += eq_v
+        report["layers"]["ir_equivalence"] = {
+            "entrypoints": eq_detail,
+            "violations": len(eq_v),
+        }
+        times["ir_equivalence"] = eq_times
 
     jit_v, jit_detail = run_jit_hygiene()
     violations += jit_v
@@ -82,6 +109,7 @@ def build_report(include_hlo: bool = True) -> dict:
         "4,2@8x64xf32": traffic_summary(Topology(8, (4, 2)), 64, 4),
         "2,2,2@8x64xf32": traffic_summary(Topology(8, (2, 2, 2)), 64, 4),
     }
+    report["program_times"] = times
     report["elapsed_s"] = round(time.perf_counter() - t0, 2)
     report["ok"] = (
         not violations and report["mutation_selftest"]["all_caught"]
@@ -97,11 +125,20 @@ def main(argv=None) -> int:
         action="store_true",
         help="skip the HLO lint layer (no JAX backend required)",
     )
+    ap.add_argument(
+        "--programs",
+        nargs="+",
+        metavar="SUBSTR",
+        help="only check matrix programs whose name contains a substring "
+        "(e.g. --programs swing '4,2@8')",
+    )
     args = ap.parse_args(argv)
 
     if not args.skip_hlo:
         _configure_cpu_mesh()
-    report = build_report(include_hlo=not args.skip_hlo)
+    report = build_report(
+        include_hlo=not args.skip_hlo, programs=args.programs
+    )
 
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:
@@ -116,6 +153,16 @@ def main(argv=None) -> int:
         f"{caught}/{len(mut['classes'])} classes caught; "
         f"{report['elapsed_s']}s"
     )
+    slowest = sorted(
+        (
+            (ms, f"{layer}:{name}")
+            for layer, rows in report["program_times"].items()
+            for name, ms in rows.items()
+        ),
+        reverse=True,
+    )[:3]
+    for ms, name in slowest:
+        print(f"  slowest: {name} {ms}ms")
     for row in report["violations"]:
         print(f"  {row['layer']}/{row['kind']} @ {row['where']}: {row['detail']}")
     for name, row in mut["classes"].items():

@@ -1,0 +1,377 @@
+"""``ir_equivalence`` pass: certify that what XLA will run is what the IR
+declares.
+
+The schedule IR (``schedule/ir.py``) is the verified object — the model
+checker proves peer symmetry / deadlock-freedom / conservation on the
+stage list, and ``compile_ir`` refuses a non-canonical program.  This
+pass closes the remaining gap: it lowers the COMPILED collective to
+StableHLO and checks the emitted collective op sequence against the IR
+stage list — count, kind, group width, permute pair count, and (for
+unrolled stages) operand wire bytes per op, extending ``hlo_lint``'s
+wire-byte parsing to a per-op positional contract.
+
+What each stage kind must lower to (``parallel/ir_lower.py``):
+
+- grouped sum ``rs``  -> one ``stablehlo.reduce_scatter`` whose
+  replica-group width equals the stage width;
+- grouped ``ag``      -> one ``stablehlo.all_gather`` (same width rule);
+- pair stages         -> one ``stablehlo.collective_permute`` per send
+  slot, with exactly ``len(stage.xfers)`` source-target pairs;
+- ring-step stages    -> ROLLED: one permute per ``fori_loop`` (two for
+  the full ring), matched by kind only (trip counts are invisible to a
+  text scan — the wire-byte caveat of ``collective_wire_bytes``);
+- lonely/non-sum grouped stages -> one rolled permute per stage.
+
+A divergence ("the executable does something the IR does not say") is
+violation kind ``ir-equivalence`` — and the mutation class
+``ir-divergence`` asserts this pass actually catches one.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..schedule import ir as sir
+from .base import Violation
+from .hlo_lint import _COLL_RE, _DTYPE_BYTES, _GRP_RE, _SIG_RE, _TENSOR_RE
+
+__all__ = [
+    "expected_hlo_sequence",
+    "actual_hlo_sequence",
+    "compare_sequences",
+    "ir_equivalence_entrypoints",
+    "run_ir_equivalence",
+    "lower_ir_divergent",
+]
+
+_PAIRS_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<[^>]*>\s*:\s*tensor<(\d+)x2xi64>"
+)
+
+
+def _chunk_sizes(total: int, n: int, chunks: int) -> list[int]:
+    blocks = total // n
+    c = max(1, min(chunks, blocks))
+    base, rem = divmod(blocks, c)
+    return [(base + (1 if i < rem else 0)) * n for i in range(c)]
+
+
+def expected_hlo_sequence(
+    prog: "sir.IRProgram", elems_per_rank: int, itemsize: int = 4,
+    op: str = "sum",
+) -> list[dict]:
+    """The collective op sequence the lowering of ``prog`` must emit, in
+    trace order, for a flat ``elems_per_rank``-element per-rank buffer.
+    Rows are dicts with ``op`` and optionally ``width`` (replica-group
+    width), ``pairs`` (permute pair count), ``bytes`` (operand bytes) and
+    ``rolled`` (kind-only match)."""
+    m = prog.scheduled
+    head = (elems_per_rank // m) * m
+    tile = head // m if m else 0
+    rows: list[dict] = []
+
+    if prog.family == "ring":
+        rows.append({"op": "collective_permute", "rolled": True})
+        rows.append({"op": "collective_permute", "rolled": True})
+    elif prog.family == "tree" and op == "sum":
+        sizes = _chunk_sizes(head, m, prog.chunks) if head else []
+        cur = {c: s for c, s in enumerate(sizes)}
+        for st in prog.stages:
+            w = prog.topo.widths[st.index]
+            size = cur.get(st.chunk, 0)
+            if st.phase == "rs":
+                rows.append(
+                    {"op": "reduce_scatter", "width": w, "bytes": size * itemsize}
+                )
+                cur[st.chunk] = size // w
+            else:
+                rows.append(
+                    {"op": "all_gather", "width": w, "bytes": size * itemsize}
+                )
+                cur[st.chunk] = size * w
+    elif prog.family in ("tree", "lonely"):
+        # non-sum trees and lonely prefix trees ride the ppermute-ring
+        # helpers: one rolled permute per grouped stage; fold/restore
+        # hops are unrolled whole-buffer permutes
+        for st in prog.stages:
+            if st.phase in ("fold", "restore"):
+                rows.append(
+                    {
+                        "op": "collective_permute",
+                        "pairs": len(st.xfers),
+                        "bytes": head * itemsize,
+                    }
+                )
+            else:
+                rows.append({"op": "collective_permute", "rolled": True})
+    else:  # swing / generalized: unrolled pair stages
+        for st in prog.stages:
+            if st.phase in ("fold", "restore"):
+                rows.append(
+                    {
+                        "op": "collective_permute",
+                        "pairs": len(st.xfers),
+                        "bytes": head * itemsize,
+                    }
+                )
+                continue
+            per_src: dict[int, int] = {}
+            for x in st.xfers:
+                per_src[x.src] = per_src.get(x.src, 0) + 1
+            n_slots = max(per_src.values())
+            k = len(st.xfers[0].blocks)
+            for j in range(n_slots):
+                pairs = sum(1 for v in per_src.values() if v > j)
+                rows.append(
+                    {
+                        "op": "collective_permute",
+                        "pairs": pairs,
+                        "bytes": k * tile * itemsize,
+                    }
+                )
+    if head < elems_per_rank:
+        rows.append({"op": "all_reduce"})  # the dense sub-N tail
+    return rows
+
+
+def actual_hlo_sequence(ir_text: str) -> list[dict]:
+    """Parse the collective ops out of lowered StableHLO, in emission
+    order, with replica-group width / permute pair count / operand bytes
+    — the per-op form of ``hlo_lint.collective_wire_bytes``'s scan."""
+    rows: list[dict] = []
+    for mt in _COLL_RE.finditer(ir_text):
+        op = mt.group(1)
+        window = ir_text[mt.start() : mt.start() + 8000]
+        sig = _SIG_RE.search(window)
+        row: dict = {"op": op}
+        if sig:
+            grp = _GRP_RE.search(window[: sig.end()])
+            if grp:
+                row["width"] = int(grp.group(1))
+            pr = _PAIRS_RE.search(window[: sig.end()])
+            if pr:
+                row["pairs"] = int(pr.group(1))
+            nbytes = 0
+            for dims, ty in _TENSOR_RE.findall(sig.group(1)):
+                n = 1
+                for d in dims.split("x"):
+                    if d:
+                        n *= int(d)
+                nbytes += n * _DTYPE_BYTES.get(ty, 4)
+            row["bytes"] = nbytes
+        rows.append(row)
+    return rows
+
+
+def compare_sequences(
+    name: str, expected: list[dict], actual: list[dict]
+) -> list[Violation]:
+    """Positional comparison; every mismatch names the op index and the
+    IR-side expectation so the drift is localizable.
+
+    Programs with ROLLED stages (ring, lonely/non-sum trees) mix inline
+    ops with ``fori_loop`` bodies, which StableHLO text outlines into
+    separate functions — text position no longer equals trace order
+    across the boundary, so those programs fall back to a multiset
+    match: every unrolled IR stage must claim a distinct emitted op
+    (kind + pairs + bytes), and the leftover ops must be exactly the
+    rolled permutes."""
+    if any(e.get("rolled") for e in expected):
+        return _compare_multiset(name, expected, actual)
+    out: list[Violation] = []
+    if len(expected) != len(actual):
+        kinds_e = [r["op"] for r in expected]
+        kinds_a = [r["op"] for r in actual]
+        out.append(
+            Violation(
+                "hlo",
+                "ir-equivalence",
+                name,
+                f"IR declares {len(expected)} collectives "
+                f"({kinds_e}), the lowered program emits {len(actual)} "
+                f"({kinds_a}): the executable diverged from the IR stage "
+                f"list",
+            )
+        )
+        return out
+    for i, (e, a) in enumerate(zip(expected, actual)):
+        if e["op"] != a["op"]:
+            out.append(
+                Violation(
+                    "hlo", "ir-equivalence", name,
+                    f"collective #{i}: IR stage lowers to {e['op']}, "
+                    f"program emits {a['op']}",
+                )
+            )
+            continue
+        if e.get("rolled"):
+            continue  # kind-only match (loop trip counts invisible)
+        for key, what in (
+            ("width", "replica-group width"),
+            ("pairs", "source-target pair count"),
+            ("bytes", "operand wire bytes"),
+        ):
+            if key in e and key in a and e[key] != a[key]:
+                out.append(
+                    Violation(
+                        "hlo", "ir-equivalence", name,
+                        f"collective #{i} ({e['op']}): IR declares {what} "
+                        f"{e[key]}, program emits {a[key]}",
+                    )
+                )
+    return out
+
+
+def _compare_multiset(
+    name: str, expected: list[dict], actual: list[dict]
+) -> list[Violation]:
+    out: list[Violation] = []
+    if len(expected) != len(actual):
+        out.append(
+            Violation(
+                "hlo", "ir-equivalence", name,
+                f"IR declares {len(expected)} collectives, the lowered "
+                f"program emits {len(actual)}: the executable diverged "
+                f"from the IR stage list",
+            )
+        )
+        return out
+    remaining = list(actual)
+    rolled = 0
+    for e in expected:
+        if e.get("rolled"):
+            rolled += 1
+            continue
+        hit = next(
+            (
+                i
+                for i, a in enumerate(remaining)
+                if a["op"] == e["op"]
+                and all(a.get(k) == e[k] for k in ("width", "pairs", "bytes") if k in e)
+            ),
+            None,
+        )
+        if hit is None:
+            out.append(
+                Violation(
+                    "hlo", "ir-equivalence", name,
+                    f"no emitted collective matches IR stage row {e} "
+                    f"(remaining ops: {remaining})",
+                )
+            )
+        else:
+            remaining.pop(hit)
+    bad = [a for a in remaining if a["op"] != "collective_permute"]
+    if len(remaining) != rolled or bad:
+        out.append(
+            Violation(
+                "hlo", "ir-equivalence", name,
+                f"rolled stages should leave exactly {rolled} "
+                f"collective_permute loop bodies, found {remaining}",
+            )
+        )
+    return out
+
+
+# ----------------------------------------------------------- entrypoints
+
+
+def _lower_ir_collective(prog: "sir.IRProgram", elems: int, op: str = "sum") -> str:
+    """Lower ``compile_ir(prog)`` over a ``prog.num_nodes``-device mesh
+    (virtual CPU devices, pinned by the analysis CLI / test harness)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import flat_mesh
+    from ..schedule.ir import compile_ir
+
+    n = prog.num_nodes
+    fn = compile_ir(prog, op=op)
+    mesh = flat_mesh(n, "ft")
+
+    def f(row):
+        return fn(row[0], "ft")[None]
+
+    sm = jax.shard_map(f, mesh=mesh, in_specs=P("ft"), out_specs=P("ft"))
+    return jax.jit(sm).lower(jnp.zeros((n, elems), jnp.float32)).as_text()
+
+
+def ir_equivalence_entrypoints() -> list[tuple[str, "sir.IRProgram", int]]:
+    """(name, program, per-rank elems) rows — every family, chunked mode
+    included; counts divisible by the block owners so the expected
+    sequence carries no tail op (the tail path is covered separately in
+    the hlo_lint budgets)."""
+    from ..schedule.stages import LonelyTopology, Topology
+
+    return [
+        ("tree_4x2", sir.tree_ir(Topology(8, (4, 2)), count=256), 256),
+        (
+            "tree_4x2_chunks2",
+            sir.tree_ir(Topology(8, (4, 2)), count=256, chunks=2),
+            256,
+        ),
+        ("ring_8", sir.ring_ir(8, count=256), 256),
+        (
+            "lonely_3x2p2",
+            sir.lonely_ir(
+                LonelyTopology(8, Topology(6, (3, 2)), 2), count=252
+            ),
+            252,
+        ),
+        ("swing_8", sir.swing_ir(8, count=256), 256),
+        ("swing_6", sir.swing_ir(6, count=256), 256),
+        ("gen_4x2_p2", sir.generalized_ir((4, 2), 2, count=256), 256),
+        ("gen_2x2x2_p1", sir.generalized_ir((2, 2, 2), 1, count=256), 256),
+    ]
+
+
+def run_ir_equivalence(
+    programs=None, times: dict | None = None
+) -> tuple[list[Violation], dict]:
+    """Lower and check every entrypoint; returns (violations, detail).
+    ``programs`` filters entrypoints by name substring; ``times`` (when
+    given) collects per-entrypoint wall-ms — the hooks the CLI report
+    uses, so the gate and the report are one loop."""
+    import time
+
+    violations: list[Violation] = []
+    detail: dict = {}
+    for name, prog, elems in ir_equivalence_entrypoints():
+        if programs and not any(p in name for p in programs):
+            continue
+        t0 = time.perf_counter()
+        expected = expected_hlo_sequence(prog, elems)
+        ir_text = _lower_ir_collective(prog, elems)
+        actual = actual_hlo_sequence(ir_text)
+        vs = compare_sequences(name, expected, actual)
+        violations += vs
+        if times is not None:
+            times[name] = round((time.perf_counter() - t0) * 1e3, 2)
+        detail[name] = {
+            "stages": len(prog.stages),
+            "collectives": len(actual),
+            "violations": len(vs),
+        }
+    return violations, detail
+
+
+# ------------------------------------------------- mutation entrypoint
+
+
+def lower_ir_divergent() -> list[Violation]:
+    """The 'ir-divergence' corruption: the LOWERED program of one IR
+    checked against the stage list of ANOTHER — the static twin of an
+    executor that silently runs a different schedule than the object the
+    model checker certified.  Numerically both are exact allreduces, so
+    only this pass can see the divergence."""
+    from ..schedule.stages import Topology
+
+    real = sir.tree_ir(Topology(8, (4, 2)), count=256)
+    claimed = sir.tree_ir(Topology(8, (2, 2, 2)), count=256)
+    ir_text = _lower_ir_collective(real, 256)
+    return compare_sequences(
+        "mutated:ir_divergent_tree",
+        expected_hlo_sequence(claimed, 256),
+        actual_hlo_sequence(ir_text),
+    )

@@ -19,11 +19,14 @@ double-count          1       ``double-count``
 chunk-overlap         1       ``chunk-overlap``
 crossed-order         1       ``deadlock`` (a real wait-for cycle)
 watchdog-removal      1       ``unbounded-wait`` (lost recv deadline)
+swing-stride          1       ``dropped-block`` (corrupted swing peers)
+genblock-truncate     1       ``dropped-block`` (truncated block-map)
 leaf-unrolled         2       ``budget``
 dtype-drift           2       ``dtype-drift``
 codec-upcast          2       ``codec-upcast``
 overlap-serialization 2       ``overlap-serialization``
 shard-regather        2       ``shard-regather`` (grads regathered)
+ir-divergence         2       ``ir-equivalence`` (executable != IR)
 wall-clock            3       ``wall-clock``
 host-rng              3       ``rng``
 traced-branch         3       ``traced-branch``
@@ -33,6 +36,9 @@ missing-static        3       ``static-argnames``
 
 from __future__ import annotations
 
+import dataclasses
+
+from ..schedule import ir as sir
 from ..schedule.stages import Topology
 from .schedule_check import (
     RECV,
@@ -40,6 +46,7 @@ from .schedule_check import (
     Half,
     PostSet,
     build_program,
+    check_ir,
     check_program,
 )
 
@@ -139,6 +146,42 @@ def _mutate_crossed_order():
     return check_program(prog)
 
 
+def _mutate_swing_stride():
+    """Corrupt the swing peer stride CONSISTENTLY (every stage-1 transfer
+    redirected two ranks over — both ends agree, so peer symmetry holds
+    and only conservation can see that block partials now land on ranks
+    that never fold them)."""
+    prog = sir.swing_ir(8, count=64)
+    st = prog.stages[1]
+    bad_xfers = tuple(
+        dataclasses.replace(x, dst=(x.dst + 2) % 8) for x in st.xfers
+    )
+    bad = dataclasses.replace(
+        prog,
+        stages=prog.stages[:1]
+        + (dataclasses.replace(st, xfers=bad_xfers),)
+        + prog.stages[2:],
+    )
+    return check_ir(bad)
+
+
+def _mutate_genblock_truncate():
+    """Truncate the generalized family's block-map symmetrically (drop the
+    last block of every stage-0 transfer on BOTH halves) — the residue
+    chains stop partitioning the owned set and those blocks' partial sums
+    are silently lost."""
+    prog = sir.generalized_ir((4, 2), 1, count=64)
+    st = prog.stages[0]
+    bad_xfers = tuple(
+        dataclasses.replace(x, blocks=x.blocks[:-1]) for x in st.xfers
+    )
+    bad = dataclasses.replace(
+        prog,
+        stages=(dataclasses.replace(st, xfers=bad_xfers),) + prog.stages[1:],
+    )
+    return check_ir(bad)
+
+
 # ----------------------------------------------------- layer 2 mutations
 
 
@@ -175,6 +218,16 @@ def _mutate_shard_regather():
 
     ir, budget = lower_shard_regather_train_step()
     return lint_ir("mutated:shard_regather_train_step", ir, budget)
+
+
+def _mutate_ir_divergence():
+    """IR/executable divergence: a lowered collective checked against a
+    DIFFERENT IR's stage list — bitwise-exact numerics on both sides, so
+    only the ``ir_equivalence`` pass can see the certified object is not
+    the object that runs."""
+    from .ir_equivalence import lower_ir_divergent
+
+    return lower_ir_divergent()
 
 
 # ----------------------------------------------------- layer 3 mutations
@@ -217,6 +270,8 @@ MUTATIONS = {
     "chunk-overlap": ("chunk-overlap", "schedule", _mutate_chunk_overlap),
     "crossed-order": ("deadlock", "schedule", _mutate_crossed_order),
     "watchdog-removal": ("unbounded-wait", "schedule", _mutate_watchdog_removal),
+    "swing-stride": ("dropped-block", "schedule", _mutate_swing_stride),
+    "genblock-truncate": ("dropped-block", "schedule", _mutate_genblock_truncate),
     "leaf-unrolled": ("budget", "hlo", _mutate_leaf_unrolled),
     "dtype-drift": ("dtype-drift", "hlo", _mutate_dtype_drift),
     "codec-upcast": ("codec-upcast", "hlo", _mutate_codec_upcast),
@@ -224,6 +279,7 @@ MUTATIONS = {
         "overlap-serialization", "hlo", _mutate_overlap_serialization,
     ),
     "shard-regather": ("shard-regather", "hlo", _mutate_shard_regather),
+    "ir-divergence": ("ir-equivalence", "hlo", _mutate_ir_divergence),
     "wall-clock": ("wall-clock", "jit", _mutate_hygiene("wall-clock")),
     "host-rng": ("rng", "jit", _mutate_hygiene("rng")),
     "traced-branch": ("traced-branch", "jit", _mutate_hygiene("traced-branch")),

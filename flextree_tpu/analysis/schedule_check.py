@@ -4,12 +4,21 @@ deadlock-free and conservation-correct before it touches a mesh.
 ``schedule/validate.py`` proves invariants of the *plans* (partition,
 send/recv agreement, convergence).  This layer goes one level down: it
 builds the explicit per-rank **message program** a schedule executes —
-every send/recv half, in issue order, for tree / ring / lonely shapes and
-for the chunk-pipelined mode (``chunks=C``) — and model-checks the program
-itself.  The distinction matters for the mutation self-test: a corruption
-is seeded into the *program* (the thing a backend would actually run), so
-a checker that silently re-derives everything from the pristine plans
-would prove nothing.
+every send/recv half, in issue order, for every schedule family (tree /
+ring / lonely / swing / generalized) and for the chunk-pipelined mode
+(``chunks=C``) — and model-checks the program itself.  The distinction
+matters for the mutation self-test: a corruption is seeded into the
+*program* (the thing a backend would actually run), so a checker that
+silently re-derives everything from the pristine plans would prove
+nothing.
+
+Since ISSUE 8 the expansion is no longer hand-written per family: every
+schedule is emitted as a declarative IR program (``schedule/ir.py``) and
+:func:`program_from_ir` is the ONE mechanical conversion from IR stages
+to the per-rank message program — the checker and the executable
+(``schedule.ir.compile_ir``) derive from the same object, eliminating
+the drift surface the old second expansion carried.  A new family gets
+deadlock/conservation proofs by writing an emitter, nothing else.
 
 Checks (every violation names ``(stage, src, dst, block)``):
 
@@ -41,17 +50,21 @@ Checks (every violation names ``(stage, src, dst, block)``):
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
+from ..schedule import ir as sir
 from ..schedule.plan import ring_plan
-from ..schedule.stages import LonelyTopology, Topology
-from ..schedule.validate import ScheduleError, stage_matches
+from ..schedule.stages import LonelyTopology, Topology, TopologyError
+from ..schedule.validate import ScheduleError
 from .base import Violation
 
 __all__ = [
     "Half",
     "PostSet",
     "Program",
+    "program_from_ir",
+    "check_ir",
     "build_program",
     "build_phase_program",
     "check_program",
@@ -61,6 +74,8 @@ __all__ = [
     "check_standard_schedules",
     "default_phase_matrix",
     "check_split_schedules",
+    "default_ir_matrix",
+    "check_ir_families",
 ]
 
 SEND, RECV = "send", "recv"
@@ -126,148 +141,96 @@ class Program:
 # --------------------------------------------------------------------- build
 
 
-def _chunk_sizes(total: int, n: int, chunks: int) -> list[int]:
-    """Mirror of ``parallel.allreduce._chunk_sizes`` without importing JAX
-    (this package must stay importable on a JAX-less host for layers 1+3)."""
-    blocks = total // n
-    c = max(1, min(chunks, blocks))
-    base, rem = divmod(blocks, c)
-    return [(base + (1 if i < rem else 0)) * n for i in range(c)]
+def _append_ir_stage(prog: Program, st: "sir.IRStage", scheduled: int) -> None:
+    """Convert ONE IR stage into per-rank post-sets.
 
-
-def _tree_stage_postsets(topo: Topology, chunk: int, phase: str):
-    """Pairwise-exchange post-sets for every (rank, stage) of one phase.
-
-    Built from ``validate.stage_matches`` — the same matched-pair table the
-    validator proves agreement on — so plans and program cannot diverge.
-    Phase 2 replays the stages reversed with the roles swapped: the blocks
-    rank ``r`` *received* in stage ``i`` (its own residue chain) are what
-    it *sends* back, and vice versa (``mpi_mod.hpp:1050-1060``).
+    Grouped stages post one set per participating rank with per-peer
+    (send, recv) half pairs in group order — the nonblocking post +
+    wait-all unit a grouped XLA collective is.  Pair stages post each
+    rank's sends then its recvs (a ring/swing step is send-right +
+    recv-left together; a one-sided fold/restore hop is a single half).
+    Whole-buffer hops (``blocks=()``) carry every scheduled block.
     """
-    match_table: dict[tuple[int, int, int], tuple[int, ...]] = {}
-    for i, src, dst, blocks in stage_matches(topo):
-        match_table[(i, src, dst)] = blocks
+    all_blocks = tuple(range(scheduled))
+    if st.lowering == "grouped":
+        send_map = {(x.src, x.dst): x.blocks for x in st.xfers}
+        for grp in st.groups:
+            for r in grp:
+                halves = []
+                for peer in grp:
+                    if peer == r:
+                        continue
+                    halves.append(Half(SEND, peer, send_map[(r, peer)]))
+                    halves.append(Half(RECV, peer, send_map[(peer, r)]))
+                prog.posts.setdefault(r, []).append(
+                    PostSet(r, halves, st.chunk, st.phase, st.index)
+                )
+        return
+    sends: dict[int, list[Half]] = {}
+    recvs: dict[int, list[Half]] = {}
+    for x in st.xfers:
+        blocks = x.blocks if x.blocks else all_blocks
+        sends.setdefault(x.src, []).append(Half(SEND, x.dst, blocks))
+        recvs.setdefault(x.dst, []).append(Half(RECV, x.src, blocks))
+    for r in sorted(set(sends) | set(recvs)):
+        halves = sends.get(r, []) + recvs.get(r, [])
+        prog.posts.setdefault(r, []).append(
+            PostSet(r, halves, st.chunk, st.phase, st.index)
+        )
 
-    out: dict[tuple[int, int], PostSet] = {}
-    stages = (
-        range(topo.num_stages)
-        if phase == "rs"
-        else reversed(range(topo.num_stages))
+
+def program_from_ir(ir_prog: "sir.IRProgram") -> Program:
+    """The ONE expansion: IR stages -> the per-rank message program.
+
+    This is what makes the IR the single source of truth — the compiler
+    lowers ``ir_prog.stages`` and this function expands the same stages
+    for the model checker, so a schedule bug cannot hide in a divergence
+    between two hand-written expansions (the pre-ISSUE-8 architecture).
+    """
+    prog = Program(
+        ir_prog.num_nodes,
+        ir_prog.family,
+        num_stages=ir_prog.num_stages,
+        chunks=ir_prog.chunks,
     )
-    for i in stages:
-        for r in range(topo.num_nodes):
-            halves = []
-            for peer in topo.group_members(i, r):
-                if peer == r:
-                    continue
-                fwd = match_table[(i, r, peer)]  # r -> peer, phase 1
-                bwd = match_table[(i, peer, r)]  # peer -> r, phase 1
-                if phase == "rs":
-                    halves.append(Half(SEND, peer, fwd))
-                    halves.append(Half(RECV, peer, bwd))
-                else:
-                    # roles swap: r returns what it collected (bwd = r's
-                    # residue chain), receives the peer's chain back
-                    halves.append(Half(SEND, peer, bwd))
-                    halves.append(Half(RECV, peer, fwd))
-            out[(r, i)] = PostSet(r, halves, chunk, phase, i)
-    return out
+    prog.head_elems = ir_prog.head_elems
+    prog.chunk_spans = list(ir_prog.chunk_spans)
+    for st in ir_prog.stages:
+        _append_ir_stage(prog, st, ir_prog.scheduled)
+    return prog
+
+
+def check_ir(ir_prog: "sir.IRProgram") -> list[Violation]:
+    """Model-check an IR program: expand via :func:`program_from_ir`, run
+    every program check.  ``schedule.ir.compile_ir`` calls this before
+    lowering and refuses the program on any violation."""
+    return check_program(program_from_ir(ir_prog))
 
 
 def _append_tree_chunk(prog: Program, topo: Topology, chunk: int, phase: str):
-    sets = _tree_stage_postsets(topo, chunk, phase)
-    stages = (
-        range(topo.num_stages)
-        if phase == "rs"
-        else reversed(range(topo.num_stages))
-    )
-    for i in stages:
-        for r in range(topo.num_nodes):
-            prog.posts.setdefault(r, []).append(sets[(r, i)])
+    """One tree phase appended from the IR emitter (shared with the
+    split-phase programs below)."""
+    for st in sir.tree_phase_stages(topo, phase, chunk=chunk):
+        _append_ir_stage(prog, st, topo.num_nodes)
 
 
 def build_program(topo, count: int | None = None, chunks: int = 1) -> Program:
     """Build the message program for one schedule execution.
 
-    ``topo``: anything ``Topology.resolve`` accepts (already resolved
-    objects pass through).  ``count``: elements per rank (defaults to one
-    block per rank times N); only the divisible head is scheduled, exactly
-    as ``tree_allreduce`` slices it.  ``chunks``: the chunk-pipelined mode
-    — chunk ``c``'s allgather is issued between chunk ``c+1``'s
-    reduce-scatter and its own, the same interleaving the jitted program
-    traces.
+    ``topo``: a resolved ``Topology``/``LonelyTopology`` or an
+    ``IRProgram`` (swing/generalized arrive only as IR).  ``count``:
+    elements per rank (defaults to one block per rank times N); only the
+    divisible head is scheduled, exactly as the executors slice it.
+    ``chunks``: the chunk-pipelined mode — chunk ``c``'s allgather is
+    issued between chunk ``c+1``'s reduce-scatter and its own, the same
+    interleaving the jitted program traces.  Everything is emitted as IR
+    (``schedule/ir.py``) and expanded by :func:`program_from_ir`.
     """
+    if isinstance(topo, sir.IRProgram):
+        return program_from_ir(topo)
     if not isinstance(topo, (Topology, LonelyTopology)):
         raise TypeError(f"resolve the topology first, got {type(topo)}")
-    n = topo.num_nodes
-    if count is None:
-        count = n * n
-    head = (count // n) * n
-
-    if isinstance(topo, LonelyTopology):
-        tree, m, l = topo.tree, topo.tree.num_nodes, topo.lonely
-        prog = Program(n, "lonely", num_stages=tree.num_stages)
-        prog.head_elems = (count // m) * m
-        prog.chunk_spans = [(0, prog.head_elems)]
-        all_blocks = tuple(range(m))
-        for i in range(l):
-            prog.posts.setdefault(m + i, []).append(
-                PostSet(m + i, [Half(SEND, i, all_blocks)], 0, "fold", 0)
-            )
-            prog.posts.setdefault(i, []).append(
-                PostSet(i, [Half(RECV, m + i, all_blocks)], 0, "fold", 0)
-            )
-        _append_tree_chunk(prog, tree, 0, "rs")
-        _append_tree_chunk(prog, tree, 0, "ag")
-        for i in range(l):
-            prog.posts.setdefault(i, []).append(
-                PostSet(i, [Half(SEND, m + i, all_blocks)], 0, "restore", 0)
-            )
-            prog.posts.setdefault(m + i, []).append(
-                PostSet(m + i, [Half(RECV, i, all_blocks)], 0, "restore", 0)
-            )
-        return prog
-
-    if topo.is_ring:
-        prog = Program(n, "ring", num_stages=1)
-        prog.head_elems = head
-        prog.chunk_spans = [(0, head)]
-        plans = [ring_plan(n, r) for r in range(n)]
-        for step in range(2 * (n - 1)):
-            phase = "rs" if step < n - 1 else "ag"
-            for r in range(n):
-                snd, rcv = plans[r][step]
-                prog.posts.setdefault(r, []).append(
-                    PostSet(
-                        r,
-                        [
-                            Half(SEND, snd.peer, snd.blocks),
-                            Half(RECV, rcv.peer, rcv.blocks),
-                        ],
-                        0,
-                        phase,
-                        step,
-                    )
-                )
-        return prog
-
-    sizes = _chunk_sizes(head, n, chunks) if head else []
-    prog = Program(
-        n, "tree", num_stages=topo.num_stages, chunks=max(1, len(sizes))
-    )
-    prog.head_elems = head
-    off = 0
-    for s in sizes:
-        prog.chunk_spans.append((off, s))
-        off += s
-    # trace order of tree_allreduce: rs0, [rs_{c+1}, ag_c]..., ag_{C-1}
-    n_chunks = max(1, len(sizes))
-    _append_tree_chunk(prog, topo, 0, "rs")
-    for c in range(1, n_chunks):
-        _append_tree_chunk(prog, topo, c, "rs")
-        _append_tree_chunk(prog, topo, c - 1, "ag")
-    _append_tree_chunk(prog, topo, n_chunks - 1, "ag")
-    return prog
+    return program_from_ir(sir.emit_ir(topo, count=count, chunks=chunks))
 
 
 # --------------------------------------------------------------------- check
@@ -439,12 +402,13 @@ def _check_conservation(prog: Program) -> list[Violation]:
     n = prog.num_nodes
     if prog.kind == "ring":
         return _check_ring_conservation(prog)
-    if prog.kind == "lonely":
-        n = n - sum(
-            1
-            for r, q in prog.posts.items()
-            if any(ps.phase == "fold" and ps.halves[0].kind == SEND for ps in q)
-        )
+    # ranks that only fold through a buddy (lonely shapes, non-power-of-two
+    # swing extras) own no blocks: the replay runs over the scheduled ranks
+    n = n - sum(
+        1
+        for r, q in prog.posts.items()
+        if any(ps.phase == "fold" and ps.halves[0].kind == SEND for ps in q)
+    )
 
     for c in range(prog.chunks):
         # ---- reduce-scatter: sends partition owned; recvs define new owned
@@ -741,12 +705,85 @@ def default_schedule_matrix(max_n: int = 16) -> list[tuple]:
     return [r for r in rows if r[1] <= max_n]
 
 
-def check_standard_schedules(max_n: int = 16) -> tuple[list[Violation], int]:
-    """Model-check the default matrix; returns (violations, programs_checked)."""
+def _row_selected(name: str, programs) -> bool:
+    """``programs``: optional substring filters (the CLI's ``--programs``)
+    — ``None``/empty selects everything."""
+    return not programs or any(p in name for p in programs)
+
+
+def check_standard_schedules(
+    max_n: int = 16, programs=None, times: dict | None = None
+) -> tuple[list[Violation], int]:
+    """Model-check the default matrix; returns (violations,
+    programs_checked).  ``programs`` filters rows by name substring;
+    ``times`` (when given) collects per-program wall-ms — both hooks
+    exist so the CLI report and this gate are the SAME loop, never two
+    drifting copies."""
     violations: list[Violation] = []
     checked = 0
     for spec, n, count, chunks in default_schedule_matrix(max_n):
+        name = f"{spec}@{n}x{count}c{chunks}"
+        if not _row_selected(name, programs):
+            continue
+        t0 = time.perf_counter()
         violations += check_schedule(spec, num_nodes=n, count=count, chunks=chunks)
+        if times is not None:
+            times[name] = round((time.perf_counter() - t0) * 1e3, 2)
+        checked += 1
+    return violations, checked
+
+
+# ------------------------------------------------- IR families (ISSUE 8)
+
+
+def default_ir_matrix(max_n: int = 16) -> list[tuple]:
+    """(spec, num_nodes, count) rows for the IR-only families: Swing at
+    power-of-two AND non-power-of-two N (the latter runs the buddy-folded
+    core), and the generalized construction at its corners (flat-tree
+    message pattern, recursive halving-doubling) plus interior ports."""
+    rows = [
+        ("swing", 4, 32),
+        ("swing", 6, 48),       # non-power-of-two: 4-core + 2 folded extras
+        ("swing", 8, 64),
+        ("swing", 12, 96),
+        ("swing", 16, 256),
+        ("gen:8@7", 8, 64),     # flat-tree corner, one round
+        ("gen:2,2,2@1", 8, 64),  # recursive halving-doubling corner
+        ("gen:4,2@2", 8, 96),
+        ("gen:4,2@1", 8, 64),
+        ("gen:3,2@1", 6, 36),
+        ("gen:4,4@3", 16, 256),
+    ]
+    return [r for r in rows if r[1] <= max_n]
+
+
+def check_ir_families(
+    max_n: int = 16, programs=None, times: dict | None = None
+) -> tuple[list[Violation], int]:
+    """Emit and model-check every IR-family row; returns (violations,
+    programs_checked).  An emitter that raises is reported as an
+    ``invalid-topology`` violation, never an analyzer crash.
+    ``programs``/``times`` as in :func:`check_standard_schedules`."""
+    violations: list[Violation] = []
+    checked = 0
+    for spec, n, count in default_ir_matrix(max_n):
+        name = f"{spec}@{n}"
+        if not _row_selected(name, programs):
+            continue
+        t0 = time.perf_counter()
+        try:
+            prog = sir.emit_ir(spec, num_nodes=n, count=count)
+        except (TopologyError, ScheduleError, ValueError, TypeError) as e:
+            violations.append(
+                Violation(
+                    "schedule", "invalid-topology", name,
+                    f"{type(e).__name__}: {e}",
+                )
+            )
+            continue
+        violations += check_ir(prog)
+        if times is not None:
+            times[name] = round((time.perf_counter() - t0) * 1e3, 2)
         checked += 1
     return violations, checked
 
@@ -1052,9 +1089,12 @@ def default_phase_matrix(max_n: int = 16) -> list[tuple]:
     return [r for r in rows if r[1] <= max_n]
 
 
-def check_split_schedules(max_n: int = 16) -> tuple[list[Violation], int]:
+def check_split_schedules(
+    max_n: int = 16, programs=None, times: dict | None = None
+) -> tuple[list[Violation], int]:
     """Model-check the standalone reduce-scatter AND all-gather programs
-    over the default phase matrix; returns (violations, programs)."""
+    over the default phase matrix; returns (violations, programs).
+    ``programs``/``times`` as in :func:`check_standard_schedules`."""
     violations: list[Violation] = []
     checked = 0
     for spec, n, count in default_phase_matrix(max_n):
@@ -1066,16 +1106,22 @@ def check_split_schedules(max_n: int = 16) -> tuple[list[Violation], int]:
             )
             continue
         for phase in ("rs", "ag"):
+            name = f"{spec}@{n}/{phase}"
+            if not _row_selected(name, programs):
+                continue
+            t0 = time.perf_counter()
             try:
                 prog = build_phase_program(topo, phase, count=count)
             except (ScheduleError, ValueError, TypeError) as e:
                 violations.append(
                     Violation(
-                        "schedule", "invalid-topology", f"{spec}/{phase}",
+                        "schedule", "invalid-topology", name,
                         f"{type(e).__name__}: {e}",
                     )
                 )
                 continue
             violations += check_phase_program(prog, topo)
+            if times is not None:
+                times[name] = round((time.perf_counter() - t0) * 1e3, 2)
             checked += 1
     return violations, checked

@@ -96,8 +96,12 @@ def main(argv=None) -> int:
     if args.cpu:
         import jax
 
+        from flextree_tpu.utils.compat import request_cpu_devices
+
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu)
+        # this jax pin has no jax_num_cpu_devices option — the compat
+        # shim falls back to XLA_FLAGS (same fix as trainer --cpu)
+        request_cpu_devices(args.cpu)
 
     if args.bench == "attention":
         from .harness import (

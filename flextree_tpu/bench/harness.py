@@ -131,9 +131,13 @@ def _xla_psum_over_mesh(stacked, mesh, axis, op):
 
 
 def run_allreduce_bench(cfg: BenchConfig) -> BenchReport:
+    from ..schedule.ir import resolve_collective
+
     n = cfg.devices or len(jax.devices())
     mesh = flat_mesh(n, "ft")
-    topo = Topology.resolve(n, cfg.topo)
+    # the widened resolver: IR-family specs ("swing", "gen:4,2@2")
+    # benchmark like any legacy topo
+    topo = resolve_collective(n, cfg.topo)
     dtype = jnp.dtype(cfg.dtype)
     rop = get_op(cfg.op)
     rop.check_dtype(dtype)

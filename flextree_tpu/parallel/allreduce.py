@@ -36,11 +36,21 @@ Mapping from the reference:
 
 from __future__ import annotations
 
+import os
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..ops.reduce import ReduceOp, get_op
+from ..schedule.ir import (
+    IRFamilySpec,
+    IRProgram,
+    compile_ir,
+    emit_ir,
+    resolve_collective,
+)
 from ..schedule.stages import LonelyTopology, Topology
 
 __all__ = [
@@ -52,6 +62,52 @@ __all__ = [
     "all_gather",
     "allgather",
 ]
+
+#: Above this axis size ``allreduce`` skips the IR emit+verify round trip
+#: at trace time (emission is O(N^2) pure Python) and dispatches straight
+#: to the identical legacy executors; the IR route stays mandatory for
+#: explicitly-requested IR families (swing/generalized) at any size.
+#: Env override: ``FT_IR_ROUTE_MAX`` (0 disables the implicit IR route).
+IR_ROUTE_MAX_ENV = "FT_IR_ROUTE_MAX"
+
+
+def _ir_route_max() -> int:
+    try:
+        return int(os.environ.get(IR_ROUTE_MAX_ENV, "64"))
+    except ValueError:
+        return 64
+
+
+@lru_cache(maxsize=512)
+def _emit_cached(resolved, chunks: int) -> IRProgram:
+    n = resolved.num_nodes
+    return emit_ir(resolved, count=n * n * max(1, chunks), chunks=chunks)
+
+
+@lru_cache(maxsize=512)
+def _compile_cached(prog: IRProgram, op_name: str):
+    return compile_ir(prog, op=op_name)
+
+
+def _ir_route(x, axis_name, resolved, rop: ReduceOp, chunks: int):
+    """Verified-before-compiled execution: emit (or accept) the IR
+    program, model-check it, lower it (``schedule.ir.compile_ir``) — the
+    checker and the executable derive from the same object.  Emission
+    and verification are memoized per (shape, chunks, op), so a jit
+    re-trace pays nothing."""
+    if isinstance(resolved, IRProgram):
+        return _compile_cached(resolved, rop.name)(x, axis_name)
+    eff_chunks = 1
+    if (
+        isinstance(resolved, Topology)
+        and not resolved.is_ring
+        and chunks > 1
+    ):
+        n = resolved.num_nodes
+        head = (x.size // n) * n
+        eff_chunks = len(_chunk_sizes(head, n, chunks)) if head else 1
+    prog = _emit_cached(resolved, eff_chunks)
+    return _compile_cached(prog, rop.name)(x, axis_name)
 
 # captured at import time so the interposer (``flextree_tpu.interpose``)
 # shadowing ``jax.lax.psum`` can never make our own tail reduction recurse
@@ -123,13 +179,26 @@ def allreduce(x: jax.Array, axis_name, topo=None, op="sum", chunks: int = 1) -> 
     shapes (see :func:`tree_allreduce`); the ring is already pipelined at
     block granularity and the lonely buddy fold is not separable, so both
     ignore ``chunks``.
+
+    Since ISSUE 8 every schedule is a verified IR program: ``topo`` also
+    accepts the IR families (``"swing"``, ``"gen:4,2@2"``, an
+    ``IRFamilySpec`` or a pre-built ``IRProgram``), and legacy shapes
+    route through ``schedule.ir.compile_ir`` too (emit -> model-check ->
+    lower, bitwise-identical to the direct executors, which remain the
+    dispatch target above :data:`IR_ROUTE_MAX_ENV` where trace-time
+    emission would not be free).
     """
     n = lax.axis_size(axis_name)
     rop = get_op(op)
     rop.check_dtype(x.dtype)
     if n <= 1:
         return x
-    topo = Topology.resolve(n, topo)
+    resolved = resolve_collective(n, topo)
+    if isinstance(resolved, (IRFamilySpec, IRProgram)):
+        return _ir_route(x, axis_name, resolved, rop, chunks)
+    if 0 < n <= _ir_route_max():
+        return _ir_route(x, axis_name, resolved, rop, chunks)
+    topo = resolved
     if isinstance(topo, LonelyTopology):
         return lonely_allreduce(x, axis_name, topo, op=rop)
     if topo.is_ring:

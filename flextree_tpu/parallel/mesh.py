@@ -15,6 +15,7 @@ import math
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..schedule.ir import resolve_collective
 from ..schedule.stages import Topology
 from .allreduce import allreduce
 
@@ -74,14 +75,16 @@ def allreduce_over_mesh(
         raise ValueError(
             f"stacked.shape[0]={stacked.shape[0]} must equal mesh axis {axis!r} size {n}"
         )
-    topo = Topology.resolve(n, topo)
+    # resolve through the widened front door so the IR families
+    # ("swing", "gen:4,2@2", IRFamilySpec) work at the host level too
+    topo = resolve_collective(n, topo)
     return _jitted_allreduce(
         mesh, axis, topo, op if isinstance(op, str) else op.name, in_place
     )(stacked)
 
 
 @functools.lru_cache(maxsize=256)
-def _jitted_allreduce(mesh: Mesh, axis: str, topo: Topology, op: str, donate: bool = False):
+def _jitted_allreduce(mesh: Mesh, axis: str, topo, op: str, donate: bool = False):
     """Cache the compiled collective per (mesh, axis, topo, op) so repeated
     host-level calls (benchmark loops) hit the jit cache instead of
     rebuilding a fresh closure every call."""

@@ -354,6 +354,18 @@ def resolve_axis_topos(mesh: Mesh, mesh_axes, grad_topo) -> dict:
             spec = spec.get(ax)
         if spec == "psum":
             return None  # sentinel: native XLA all-reduce on this axis
+        from ..schedule.ir import is_ir_family_spec
+
+        if is_ir_family_spec(spec):
+            # the train sync seam (bucketing, cost model, zero layout)
+            # prices and executes legacy topologies only — refusing loudly
+            # beats the flat fallback silently discarding a measured plan
+            # (IR families on this seam are the named ROADMAP follow-up)
+            raise TopologyError(
+                f"grad_topo {spec!r} on axis {ax!r}: IR families "
+                f"(swing/generalized) are not supported on the train sync "
+                f"seam yet — use a widths-vector spec or 'psum'"
+            )
         try:
             return Topology.resolve(mesh.shape[ax], spec)
         except TopologyError:
@@ -512,6 +524,10 @@ def maybe_autotune_grad_topo(
             n, nbytes, dtype="float32", codecs=(train_cfg.codec,), top_k=3,
             repeat=3, overlap=train_cfg.overlap,
             sharded=train_cfg.shard_optimizer,
+            # the train sync seam executes legacy topologies only (see
+            # resolve_axis_topos): never offer the measured search a
+            # winner the step builder would have to refuse
+            ir_families=(),
         )
         spec[ax] = plan.to_ft_topo()
     return dataclasses.replace(train_cfg, grad_topo=spec, autotune=False)

@@ -32,6 +32,7 @@ import json
 import os
 from dataclasses import dataclass
 
+from ..schedule.ir import IRFamilySpec
 from ..schedule.stages import LonelyTopology, Topology
 from .calibrate import (
     CALIBRATION_SCHEMA,
@@ -46,14 +47,29 @@ __all__ = [
     "analytic_shortlist",
     "autotune_plan",
     "DEFAULT_CODECS",
+    "DEFAULT_IR_FAMILIES",
 ]
 
 DEFAULT_CODECS = ("f32", "bf16", "int8")
 
+#: schedule-IR families offered to the measured search by default
+#: (ISSUE 8): the analytic model ranks them honestly (swing pays its
+#: distance-weighted wire, generalized its per-round launches), and when
+#: one makes the shortlist the measurement — not the model — decides.
+#: They enter only for the identity codec and unsharded plans (no
+#: compressed / split-phase lowering for IR families yet).
+DEFAULT_IR_FAMILIES = ("swing", "generalized")
+
 
 @dataclass(frozen=True)
 class TunedPlan:
-    """Autotuner output: the winning (shape, codec) plus provenance."""
+    """Autotuner output: the winning (shape, codec) plus provenance.
+
+    ``family`` records which schedule family won: ``"tree"`` for every
+    legacy shape (ring included) or an IR family (``"swing"`` /
+    ``"generalized"``).  Cache entries persist it, so an IR winner can
+    never be replayed as (or aliased against) a legacy widths vector —
+    the no-alias guard of the plan cache."""
 
     num_nodes: int
     nbytes: int
@@ -64,10 +80,18 @@ class TunedPlan:
     predicted_us: float
     measured_us: float | None
     source: str  # "measured" | "cache" | "analytic"
-    #: ranked shortlist rows: (widths, lonely, codec, predicted_us, measured_us)
+    #: ranked shortlist rows: (shape, lonely, codec, predicted_us,
+    #: measured_us) — ``shape`` is a widths tuple for legacy rows, an
+    #: ``"swing"``/``"gen:..."`` spec string for IR rows
     table: tuple = ()
+    family: str = "tree"
+    ports: int = 0
 
     def to_ft_topo(self) -> str:
+        if self.family == "swing":
+            return "swing"
+        if self.family == "generalized":
+            return f"gen:{','.join(map(str, self.widths))}@{self.ports}"
         spec = ",".join(map(str, self.widths))
         if self.lonely:
             spec += f"+{self.lonely}"
@@ -75,6 +99,12 @@ class TunedPlan:
 
     @property
     def topology(self):
+        if self.family == "swing":
+            return IRFamilySpec("swing", self.num_nodes)
+        if self.family == "generalized":
+            return IRFamilySpec(
+                "generalized", self.num_nodes, self.widths, self.ports
+            )
         if self.widths == (1,):
             return Topology.ring(self.num_nodes)
         if self.lonely:
@@ -93,22 +123,38 @@ def analytic_shortlist(
     params=None,
     top_k: int = 4,
     sharded: bool = False,
-) -> list[tuple[tuple[int, ...], int, str, float]]:
-    """Top-K ``(widths, lonely, codec, predicted_us)`` over the shape x
-    codec product, cheapest first.  The overall analytic argmin is rank 0
-    by construction.  ``sharded`` prices one ZeRO sync round (grad
-    reduce-scatter + param all-gather — ``choose_topology(collective=
-    "sharded")``) instead of the fused allreduce."""
+    ir_families=DEFAULT_IR_FAMILIES,
+) -> list[tuple]:
+    """Top-K ``(shape, lonely, codec, predicted_us)`` over the shape x
+    codec product, cheapest first — ``shape`` is a widths tuple for
+    legacy candidates or an ``IRFamilySpec`` for swing/generalized rows
+    (offered under the identity codec only).  The overall analytic
+    argmin is rank 0 by construction.  ``sharded`` prices one ZeRO sync
+    round (grad reduce-scatter + param all-gather —
+    ``choose_topology(collective="sharded")``) instead of the fused
+    allreduce, and excludes IR families (no split-phase lowering)."""
     if params is None:
         params = default_params()
-    rows: list[tuple[tuple[int, ...], int, str, float]] = []
+    rows: list[tuple] = []
     for codec in codecs:
+        offer_ir = (
+            tuple(ir_families) if codec == "f32" and not sharded else ()
+        )
         plan = choose_topology(
             n, nbytes, params=params, codec=codec,
             collective="sharded" if sharded else "allreduce",
+            ir_families=offer_ir,
         )
         for c in plan.candidates:
-            rows.append((c.widths, c.lonely, codec, c.total_us))
+            if c.family == "tree":
+                rows.append((c.widths, c.lonely, codec, c.total_us))
+            else:
+                fam = (
+                    IRFamilySpec("swing", n)
+                    if c.family == "swing"
+                    else IRFamilySpec("generalized", n, c.widths, c.ports)
+                )
+                rows.append((fam, 0, codec, c.total_us))
     rows.sort(key=lambda r: r[3])
     return rows[: max(1, top_k)]
 
@@ -186,7 +232,10 @@ def _default_timer(candidates, n, nbytes, dtype, repeat, sharded: bool = False):
 
     calls = {}
     for i, (widths, lonely, codec, _pred) in enumerate(candidates):
-        spec = ",".join(map(str, widths)) + (f"+{lonely}" if lonely else "")
+        if isinstance(widths, IRFamilySpec):
+            spec = widths.spec  # "swing" / "gen:...": allreduce resolves it
+        else:
+            spec = ",".join(map(str, widths)) + (f"+{lonely}" if lonely else "")
 
         def device_fn(row, spec=spec, codec=codec):
             if sharded:
@@ -225,6 +274,7 @@ def autotune_plan(
     use_cache: bool = True,
     overlap: bool = False,
     sharded: bool = False,
+    ir_families=DEFAULT_IR_FAMILIES,
 ) -> TunedPlan:
     """Pick the gradient-sync plan by measurement.
 
@@ -253,7 +303,8 @@ def autotune_plan(
     """
     codecs = tuple(codecs)
     shortlist = analytic_shortlist(
-        n, nbytes, codecs, params=params, top_k=top_k, sharded=sharded
+        n, nbytes, codecs, params=params, top_k=top_k, sharded=sharded,
+        ir_families=ir_families,
     )
     fp = backend_fingerprint()
     key = plan_cache_key(
@@ -273,6 +324,11 @@ def autotune_plan(
                 float(hit["predicted_us"]), float(hit["measured_us"]),
                 source="cache",
                 table=tuple(tuple(r) for r in hit.get("table", ())),
+                # the no-alias guard: an IR-family winner is stored WITH
+                # its family and can never round-trip as a tree widths
+                # vector (tests/test_schedule_ir.py pins this)
+                family=hit.get("family", "tree"),
+                ports=int(hit.get("ports", 0)),
             )
 
     if timer is None:
@@ -284,15 +340,23 @@ def autotune_plan(
             f"timer returned {len(measured_s)} times for "
             f"{len(shortlist)} candidates"
         )
+    def _row_shape(shape):
+        return shape.spec if isinstance(shape, IRFamilySpec) else shape
+
     table = tuple(
-        (widths, lonely, codec, pred, t * 1e6)
-        for (widths, lonely, codec, pred), t in zip(shortlist, measured_s)
+        (_row_shape(shape), lonely, codec, pred, t * 1e6)
+        for (shape, lonely, codec, pred), t in zip(shortlist, measured_s)
     )
     best_i = min(range(len(shortlist)), key=lambda i: measured_s[i])
-    widths, lonely, codec, pred = shortlist[best_i]
+    shape, lonely, codec, pred = shortlist[best_i]
+    if isinstance(shape, IRFamilySpec):
+        family, widths, ports = shape.family, shape.widths, shape.ports
+    else:
+        family, widths, ports = "tree", shape, 0
     plan = TunedPlan(
         n, nbytes, dtype, widths, lonely, codec, pred,
         measured_s[best_i] * 1e6, source="measured", table=table,
+        family=family, ports=ports,
     )
     if use_cache and path:
         doc = _cache_load(path)
@@ -301,10 +365,13 @@ def autotune_plan(
             "widths": list(widths),
             "lonely": lonely,
             "codec": codec,
+            "family": family,
+            "ports": ports,
             "predicted_us": pred,
             "measured_us": plan.measured_us,
             "table": [
-                [list(w), l, c, p, m] for (w, l, c, p, m) in table
+                [list(w) if not isinstance(w, str) else w, l, c, p, m]
+                for (w, l, c, p, m) in table
             ],
         }
         _cache_store(path, doc)

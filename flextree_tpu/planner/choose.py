@@ -28,15 +28,18 @@ import dataclasses
 import math
 from dataclasses import dataclass
 
+from ..schedule.ir import IRFamilySpec
 from ..schedule.stages import LonelyTopology, Topology
 from .cost_model import (
     CostBreakdown,
     TpuCostParams,
     all_gather_cost,
     allreduce_cost,
+    generalized_cost,
     lonely_allreduce_cost,
     reduce_scatter_cost,
     sharded_sync_cost,
+    swing_cost,
 )
 from .factorize import is_prime, ordered_factorizations
 
@@ -60,10 +63,26 @@ class Candidate:
     cost: CostBreakdown
     torus_aligned: bool = False
     lonely: int = 0  # ranks outside the tree (executable "+k" shapes)
+    # IR families (ISSUE 8): "tree" covers every legacy shape (the ring
+    # rides widths=(1,)); "swing"/"generalized" are schedule-IR families
+    # executed through schedule.ir.compile_ir.  ``ports`` is the
+    # generalized construction's per-round port count.
+    family: str = "tree"
+    ports: int = 0
 
     @property
     def total_us(self) -> float:
         return self.cost.total_us
+
+    def shape_label(self) -> str:
+        if self.family == "swing":
+            return "swing"
+        if self.family == "generalized":
+            return f"gen:{','.join(map(str, self.widths))}@{self.ports}"
+        label = "ring" if self.widths == (1,) else "*".join(map(str, self.widths))
+        if self.lonely:
+            label += f"+{self.lonely}"
+        return label
 
 
 @dataclass(frozen=True)
@@ -81,7 +100,10 @@ class Plan:
         return self.topology.widths
 
     def to_ft_topo(self) -> str:
-        """The ``FT_TOPO`` env value selecting this plan."""
+        """The ``FT_TOPO`` env value selecting this plan (IR families
+        return their own spec grammar: ``"swing"`` / ``"gen:4,2@2"``)."""
+        if isinstance(self.topology, IRFamilySpec):
+            return self.topology.spec
         spec = ",".join(map(str, self.topology.widths))
         if isinstance(self.topology, LonelyTopology):
             spec += f"+{self.topology.lonely}"
@@ -94,9 +116,7 @@ class Plan:
         ]
         for c in self.candidates[:8]:
             mark = " torus" if c.torus_aligned else ""
-            shape = "ring" if c.widths == (1,) else "*".join(map(str, c.widths))
-            if c.lonely:
-                shape += f"+{c.lonely}"
+            shape = c.shape_label()
             lines.append(
                 f"  {shape:>12}: {c.total_us:9.1f} µs "
                 f"(lat {c.cost.latency_us:.1f} + bw {c.cost.bandwidth_us:.1f} "
@@ -153,6 +173,7 @@ def choose_topology(
     dcn_axes: tuple[int, ...] = (),
     codec=None,
     collective: str = "allreduce",
+    ir_families: tuple[str, ...] = (),
 ) -> Plan:
     """Pick the cheapest topology for ``n`` devices and ``nbytes``/chip.
 
@@ -177,6 +198,16 @@ def choose_topology(
     + quantized param all-gather, ``cost_model.sharded_sync_cost``).
     Split collectives have no lonely candidates (lonely ranks own no
     block — the runtime falls back to the flat tree there too).
+
+    ``ir_families``: opt-in schedule-IR families for the candidate table
+    (``("swing", "generalized")`` — ISSUE 8).  Only meaningful for the
+    fused ``"allreduce"`` collective (the IR families have no split-phase
+    or compressed lowering yet); the default keeps the historical
+    candidate set byte-for-byte, and ``planner.autotune.autotune_plan``
+    passes the full set so measurement, not the model, gets the final
+    word on the wider space.  IR candidates never win a cost TIE against
+    a legacy shape (the sort prefers proven grouped-collective lowerings
+    at equal predicted time).
     """
     if n < 1:
         raise ValueError(f"n must be >= 1, got {n}")
@@ -251,6 +282,43 @@ def choose_topology(
         cost = cost_fn(topo, dcn_stages=dcn_stages)
         cands.append(Candidate(widths, cost, aligned))
 
+    if ir_families and collective == "allreduce" and n >= 2:
+        if "swing" in ir_families:
+            core = 1 << (n.bit_length() - 1)
+            cands.append(
+                Candidate(
+                    (2,) * (core.bit_length() - 1),
+                    swing_cost(
+                        n, nbytes, params, crosses_dcn=bool(dcn_axes),
+                        codec=codec,
+                    ),
+                    False,
+                    family="swing",
+                )
+            )
+        if "generalized" in ir_families:
+            for widths in ordered_factorizations(n):
+                # the construction's interesting ports corners: fully
+                # serial rounds and fully parallel (tree-pattern) rounds
+                for p in sorted({1, max(widths) - 1}):
+                    if p < 1:
+                        continue
+                    dcn_gen = (
+                        tuple(range(len(widths))) if dcn_axes else ()
+                    )
+                    cands.append(
+                        Candidate(
+                            widths,
+                            generalized_cost(
+                                widths, p, nbytes, params,
+                                dcn_stages=dcn_gen, codec=codec,
+                            ),
+                            False,
+                            family="generalized",
+                            ports=p,
+                        )
+                    )
+
     advisory: tuple[str, ...] = ()
     if is_prime(n) and n > 3 and collective == "allreduce":
         # Prime N: the reference could only *advise* resizing to N±1
@@ -283,13 +351,24 @@ def choose_topology(
             )
         advisory = tuple(near)
 
-    # prefer torus-aligned shapes at equal cost, then in-tree over lonely,
-    # then fewer stages
+    # prefer torus-aligned shapes at equal cost, then legacy grouped
+    # lowerings over IR families, then in-tree over lonely, then fewer
+    # stages
     cands.sort(
-        key=lambda c: (c.total_us, not c.torus_aligned, c.lonely, len(c.widths))
+        key=lambda c: (
+            c.total_us,
+            not c.torus_aligned,
+            c.family != "tree",
+            c.lonely,
+            len(c.widths),
+        )
     )
     best = cands[0]
-    if best.lonely:
+    if best.family == "swing":
+        topo = IRFamilySpec("swing", n)
+    elif best.family == "generalized":
+        topo = IRFamilySpec("generalized", n, best.widths, best.ports)
+    elif best.lonely:
         topo = LonelyTopology(n, Topology(n - best.lonely, best.widths), best.lonely)
     elif best.widths == (1,):
         topo = Topology.ring(n)

@@ -27,8 +27,10 @@ All times in microseconds, sizes in bytes.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
+from ..schedule.ir import swing_rho
 from ..schedule.stages import Topology
 
 __all__ = [
@@ -38,6 +40,8 @@ __all__ = [
     "allreduce_cost",
     "lonely_allreduce_cost",
     "ring_cost",
+    "swing_cost",
+    "generalized_cost",
     "reduce_scatter_cost",
     "all_gather_cost",
     "sharded_sync_cost",
@@ -263,6 +267,107 @@ def ring_cost(
             params.codec_bw_GBps * 1e3
         )
     return CostBreakdown(lat, bw, red, 0.0, cod)
+
+
+# ---------------------------------------------------------------------------
+# IR-family costs (ISSUE 8): swing short-cut rings, generalized allreduce
+# ---------------------------------------------------------------------------
+
+
+def swing_cost(
+    n: int,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    crosses_dcn: bool = False,
+    codec=None,
+) -> CostBreakdown:
+    """Swing short-cut ring (arXiv:2401.09356, ``schedule.ir.swing_ir``):
+    ``log2(P)`` pairwise steps per phase over the largest power-of-two
+    core ``P``, step ``s`` moving ``S / 2^(s+1)`` bytes to a peer at ring
+    distance ``|rho_s|`` (1, 1, 3, 5, 11, ...).
+
+    Bandwidth term per arXiv:2409.04202's treatment: an alpha-beta model
+    that ignores WHERE the bytes go mis-ranks multi-hop algorithms, so
+    each step's wire time is weighted by its link occupancy — a
+    distance-``d`` permute on a ring fabric holds ``d`` links for the
+    whole transfer, so the effective per-chip wire time scales by ``d``
+    (min of the two ring directions).  This is what makes the model
+    honest about swing vs the tree on a torus: swing's total weighted
+    distance ``sum_s d_s / 2^(s+1)`` beats RHD's doubling distances but
+    still pays more than a one-axis grouped collective; it wins where
+    per-step latency dominates or the fabric is switch-like (calibration
+    can flatten the distance penalty via link constants).
+
+    Non-power-of-two ``n``: the ``n - P`` extras pay the lonely buddy
+    protocol (two full-payload hops + one fold), same terms as
+    :func:`lonely_allreduce_cost`.
+    """
+    if n <= 1:
+        return CostBreakdown(0.0, 0.0, 0.0, 0.0)
+    ratio, hop_cost = _codec_props(codec)
+    link = params.dcn if crosses_dcn else params.ici
+    core = 1 << (n.bit_length() - 1)
+    extras = n - core
+    k = core.bit_length() - 1
+    lat = bw = red = cod = 0.0
+    for s in range(k):
+        # the canonical displacement sequence the emitter executes
+        # (schedule.ir.swing_rho) — never a re-derived copy
+        rho = abs(swing_rho(s))
+        dist = min(rho % core, core - rho % core) or 1
+        step_bytes = nbytes / (1 << (s + 1))
+        # two phases (reduce-scatter down, all-gather back)
+        lat += 2 * (dist * link.latency_us + params.launch_us)
+        bw += 2 * dist * link.time_us(step_bytes * ratio)
+        red += step_bytes / (params.reduce_bw_GBps * 1e3)  # phase-1 fold
+        if hop_cost:
+            cod += 2 * 2 * step_bytes / (params.codec_bw_GBps * 1e3)
+    if extras:
+        lat += 2 * (link.latency_us + params.launch_us)
+        bw += 2 * link.time_us(nbytes * ratio)
+        red += nbytes / (params.reduce_bw_GBps * 1e3)
+        if hop_cost:
+            cod += 4 * nbytes / (params.codec_bw_GBps * 1e3)
+    return CostBreakdown(lat, bw, red, 0.0, cod)
+
+
+def generalized_cost(
+    widths: tuple[int, ...],
+    ports: int,
+    nbytes: int,
+    params: TpuCostParams = TpuCostParams(),
+    dcn_stages: tuple[int, ...] = (),
+    codec=None,
+) -> CostBreakdown:
+    """The generalized construction (arXiv:2004.09362,
+    ``schedule.ir.generalized_ir``): tree-shaped stages executed as
+    ``ceil((w-1)/ports)`` pairwise rounds each.  Per stage the byte
+    profile equals the tree's (``(w-1)/w * S/g`` per phase — the
+    telescoping identity holds for any execution of the same block-map),
+    so the family trades on LATENCY: each round pays a launch, and
+    ``ports`` rounds-in-flight trade launch count against per-round
+    control overhead.  ``widths=(N,), ports=N-1`` prices like the flat
+    tree message pattern; ``widths=(2,..,2), ports=1`` like RHD over
+    permutes."""
+    topo = Topology(math.prod(widths), tuple(widths))
+    ratio, hop_cost = _codec_props(codec)
+    links = _stage_links(topo, params, dcn_stages)
+    lat = bw = red = ctl = cod = 0.0
+    for i, w in enumerate(topo.widths):
+        g = topo.gaps[i]
+        link = links[i]
+        p = min(ports, w - 1)
+        rounds = -(-(w - 1) // p)
+        stage_bytes = (w - 1) / w * (nbytes / g)
+        lat += 2 * (rounds * params.launch_us + (w - 1) * link.latency_us)
+        bw += 2 * link.time_us(stage_bytes * ratio)
+        red += stage_bytes / (params.reduce_bw_GBps * 1e3)
+        ctl += 2 * rounds * params.control_us_per_width * max(0, p - 1)
+        if hop_cost:
+            cod += 2 * (nbytes / g) / (params.codec_bw_GBps * 1e3)
+    if hop_cost:
+        cod += (nbytes / topo.num_nodes + nbytes) / (params.codec_bw_GBps * 1e3)
+    return CostBreakdown(lat, bw, red, ctl, cod)
 
 
 # ---------------------------------------------------------------------------

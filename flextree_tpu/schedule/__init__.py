@@ -14,6 +14,22 @@ from .stages import (
     split_lonely_spec,
 )
 from .blocks import BlockLayout
+from .ir import (
+    IRFamilySpec,
+    IRProgram,
+    IRStage,
+    IRViolationError,
+    IRXfer,
+    compile_ir,
+    emit_ir,
+    generalized_ir,
+    lonely_ir,
+    resolve_collective,
+    ring_ir,
+    swing_ir,
+    tree_ir,
+    verify_ir,
+)
 from .plan import (
     Operation,
     tree_block_set,
@@ -39,6 +55,20 @@ __all__ = [
     "get_stages",
     "FT_TOPO_ENV",
     "BlockLayout",
+    "IRFamilySpec",
+    "IRProgram",
+    "IRStage",
+    "IRViolationError",
+    "IRXfer",
+    "compile_ir",
+    "emit_ir",
+    "tree_ir",
+    "ring_ir",
+    "lonely_ir",
+    "swing_ir",
+    "generalized_ir",
+    "resolve_collective",
+    "verify_ir",
     "Operation",
     "tree_block_set",
     "send_plan",

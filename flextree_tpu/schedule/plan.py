@@ -22,12 +22,19 @@ Invariants (property-tested in ``tests/test_schedule.py``):
 - after all stages rank ``r`` exclusively owns ``{b : b ≡ r (mod N)}``,
   i.e. exactly one block per rank when widths multiply to N;
 - phase 2 (reversed stages, send/recv roles swapped) restores full ownership.
+
+Since ISSUE 8 the residue-chain math itself lives in ``schedule/ir.py``
+(``stage_send_blocks`` / ``stage_keep_blocks``) — the IR emitter is the
+single source of truth, and ``send_plan``/``recv_plan`` are thin views
+over it, so the NumPy simulator, the plan validator and the IR-driven
+model checker can never disagree about which blocks move where.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
+from .ir import stage_keep_blocks, stage_send_blocks
 from .stages import Topology
 
 __all__ = [
@@ -51,8 +58,9 @@ class Operation:
     @classmethod
     def strided(cls, peer: int, total: int, gap: int) -> "Operation":
         """Tree-stage op: blocks ``{peer % gap, peer%gap+gap, ...} < total``
-        (the reference's first ``Operation`` ctor, ``mpi_mod.hpp:56-64``)."""
-        return cls(peer, tuple(range(peer % gap, total, gap)))
+        (the reference's first ``Operation`` ctor, ``mpi_mod.hpp:56-64``) —
+        a view over ``ir.stage_send_blocks`` with the stride pre-folded."""
+        return cls(peer, stage_send_blocks(total, gap, 1, peer))
 
     @classmethod
     def single(cls, peer: int, block: int) -> "Operation":
@@ -61,13 +69,15 @@ class Operation:
 
 
 def tree_block_set(rank: int, total: int, stride: int) -> tuple[int, ...]:
-    """``{b : b ≡ rank (mod stride), b < total}`` — the residue chain."""
-    return tuple(range(rank % stride, total, stride))
+    """``{b : b ≡ rank (mod stride), b < total}`` — the residue chain
+    (view over ``ir.stage_keep_blocks``)."""
+    return stage_keep_blocks(total, stride, 1, rank)
 
 
 def send_plan(topo: Topology, rank: int) -> list[list[Operation]]:
     """Phase-1 send ops per stage for ``rank``: ``plan[stage][j]`` sends
-    ``plan[stage][j].blocks`` to ``plan[stage][j].peer``.
+    ``plan[stage][j].blocks`` to ``plan[stage][j].peer`` — a per-rank view
+    over the IR emitter's block math (``ir.stage_send_blocks``).
 
     Self-ops (peer == rank) are *included*, as in the reference (the transport
     skips them at ``mpi_mod.hpp:676``); the simulator/backends decide.
@@ -76,9 +86,8 @@ def send_plan(topo: Topology, rank: int) -> list[list[Operation]]:
     plan: list[list[Operation]] = []
     for i, w in enumerate(topo.widths):
         g = topo.gaps[i]
-        stride = g * w
         stage_ops = [
-            Operation.strided(peer, n, stride)
+            Operation(peer, stage_send_blocks(n, g, w, peer))
             for peer in topo.group_members(i, rank)
         ]
         plan.append(stage_ops)
@@ -88,13 +97,13 @@ def send_plan(topo: Topology, rank: int) -> list[list[Operation]]:
 def recv_plan(topo: Topology, rank: int) -> list[list[Operation]]:
     """Phase-1 recv ops per stage: same peers as ``send_plan`` but every op
     carries ``rank``'s own residue chain ``{b : b ≡ rank (mod g*w)}``
-    (``Recv_Ops::generate_ops``, ``mpi_mod.hpp:192-209``)."""
+    (``Recv_Ops::generate_ops``, ``mpi_mod.hpp:192-209``; the chain is
+    ``ir.stage_keep_blocks`` — the same function the IR emitter uses)."""
     n = topo.num_nodes
     plan: list[list[Operation]] = []
     for i, w in enumerate(topo.widths):
         g = topo.gaps[i]
-        stride = g * w
-        mine = tree_block_set(rank, n, stride)
+        mine = stage_keep_blocks(n, g, w, rank)
         stage_ops = [Operation(peer, mine) for peer in topo.group_members(i, rank)]
         plan.append(stage_ops)
     return plan

@@ -10,9 +10,18 @@ It executes, in order:
 1. **repo lint** — every ``.py`` file under ``flextree_tpu/``, ``tests/``
    and ``tools/`` must byte-compile (catches syntax rot in files no test
    imports), and no ``__pycache__``/``.pyc`` may be tracked by git;
-2. **the three analysis layers + mutation self-test** via
-   ``flextree_tpu.analysis`` (schedule model checker, HLO linter,
-   jit-hygiene lint), writing the JSON report.
+2. **the analysis layers + mutation self-test** via
+   ``flextree_tpu.analysis`` (schedule model checker incl. IR families,
+   HLO linter, ir-equivalence pass, jit-hygiene lint), writing the JSON
+   report;
+3. with ``--staleness-gate`` (the CI lint job passes it): the COMMITTED
+   report at ``--report`` must match the fresh run, modulo the volatile
+   keys (``elapsed_s``, ``program_times``) — a committed ANALYSIS.json
+   that no longer reflects the tree is a silently-rotting artifact, and
+   before this gate it could drift forever without failing anything.
+   On mismatch the tool prints the differing paths and exits non-zero;
+   the fix is always ``python -m flextree_tpu.analysis --report
+   ANALYSIS.json`` and committing the result.
 
 Exit status 0 iff everything is green — the same contract as
 ``python -m flextree_tpu.analysis``, widened with the repo lint.  The
@@ -23,6 +32,7 @@ tool exists so the gate does not require pytest.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -62,12 +72,52 @@ def repo_lint() -> list[str]:
     return problems
 
 
+#: report keys that legitimately change run-to-run (wall-clock noise) —
+#: everything else in the committed artifact must match a fresh run
+VOLATILE_KEYS = ("elapsed_s", "program_times")
+
+
+def _stable_view(report: dict) -> dict:
+    return {k: v for k, v in report.items() if k not in VOLATILE_KEYS}
+
+
+def _diff_paths(a, b, prefix="") -> list[str]:
+    """Paths where two JSON values differ (bounded list, for the log)."""
+    if type(a) is not type(b):
+        return [f"{prefix or '.'}: {type(a).__name__} != {type(b).__name__}"]
+    if isinstance(a, dict):
+        out = []
+        for k in sorted(set(a) | set(b)):
+            if k not in a:
+                out.append(f"{prefix}.{k}: only in fresh run")
+            elif k not in b:
+                out.append(f"{prefix}.{k}: only in committed report")
+            else:
+                out += _diff_paths(a[k], b[k], f"{prefix}.{k}")
+        return out[:20]
+    if isinstance(a, list):
+        if len(a) != len(b):
+            return [f"{prefix}: list length {len(a)} != {len(b)}"]
+        out = []
+        for i, (x, y) in enumerate(zip(a, b)):
+            out += _diff_paths(x, y, f"{prefix}[{i}]")
+        return out[:20]
+    if a != b:
+        return [f"{prefix}: {a!r} != {b!r}"]
+    return []
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--report", default="ANALYSIS.json")
     ap.add_argument(
         "--skip-hlo", action="store_true",
         help="pass through to the analysis CLI (no JAX backend needed)",
+    )
+    ap.add_argument(
+        "--staleness-gate", action="store_true",
+        help="fail unless the committed report matches a fresh run "
+        "(modulo volatile wall-time keys)",
     )
     args = ap.parse_args(argv)
 
@@ -76,10 +126,42 @@ def main(argv=None) -> int:
         print(f"repo-lint: {p}")
     print(f"repo lint: {len(problems)} problems")
 
+    committed = None
+    report_abspath = os.path.join(REPO, args.report)
+    if args.staleness_gate:
+        try:
+            with open(report_abspath, encoding="utf-8") as fh:
+                committed = json.load(fh)
+        except (OSError, ValueError) as e:
+            problems.append(
+                f"staleness gate: cannot read committed {args.report}: {e}"
+            )
+
     cli = [sys.executable, "-m", "flextree_tpu.analysis", "--report", args.report]
     if args.skip_hlo:
         cli.append("--skip-hlo")
     rc = subprocess.run(cli, cwd=REPO).returncode
+
+    if args.staleness_gate and committed is not None:
+        try:
+            with open(report_abspath, encoding="utf-8") as fh:
+                fresh = json.load(fh)
+        except (OSError, ValueError) as e:
+            problems.append(f"staleness gate: fresh report unreadable: {e}")
+        else:
+            diffs = _diff_paths(_stable_view(committed), _stable_view(fresh))
+            if diffs:
+                print(
+                    f"staleness gate: committed {args.report} does not match "
+                    f"a fresh run — regenerate with `python -m "
+                    f"flextree_tpu.analysis --report {args.report}` and "
+                    f"commit the result"
+                )
+                for d in diffs:
+                    print(f"  stale: {d}")
+                problems.append(f"stale {args.report} ({len(diffs)} paths)")
+            else:
+                print(f"staleness gate: {args.report} matches the fresh run")
     return 1 if problems else rc
 
 
