@@ -634,6 +634,55 @@ def run_quantize_tripwire(timeout_s: int = 240) -> dict:
         return {"quant_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def run_serving_tripwire(timeout_s: int = 900) -> dict:
+    """Supplementary keys ``serving_paged_bitwise_violations`` (requests
+    served by the continuous batcher over the paged KV cache produce
+    exactly the contiguous-cache ``generate``'s tokens on this exact
+    tree; 0 = identical) and ``serving_p99_regression`` (1 if the
+    continuous batcher's p99 time-to-first-token exceeds the static
+    batch-barrier baseline's at equal offered load — structurally it
+    should be well under).  Runs ``tools/bench_serving.py --smoke`` in a
+    subprocess (it pins its own CPU backend; a wedged run must never
+    hang the driver) and reads the artifact it writes.  Absent keys read
+    as "not verified", never as "clean"."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        floors = doc["floors"]
+        out = {
+            "serving_paged_bitwise_violations": floors[
+                "paged_bitwise_violations"
+            ],
+            "serving_p99_regression": floors["p99_regression"],
+            # informational: the enforced >=1.3x floor lives in the full
+            # (non-smoke) run committed as BENCH_SERVING.json
+            "serving_throughput_ratio": floors["throughput_ratio"],
+        }
+        if not floors["replica_kill"]["ok"]:
+            out["serving_error"] = "replica-kill scenario failed"
+        elif p.returncode != 0:
+            out["serving_error"] = f"bench_serving rc={p.returncode}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"serving_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -702,6 +751,7 @@ def main() -> int:
         result.update(run_quantize_tripwire())
         result.update(run_overlap_tripwire())
         result.update(run_sharded_tripwire())
+        result.update(run_serving_tripwire())
     print(json.dumps(result))
     return 0
 

@@ -1,6 +1,14 @@
 """Model substrate the collectives serve: dense transformer LM + MoE LM."""
 
-from .generate import decode_step, generate, init_kv_cache, prefill
+from .generate import (
+    cached_attention,
+    decode_step,
+    generate,
+    init_kv_cache,
+    prefill,
+    prefill_ragged,
+    sample_token,
+)
 from .moe import (
     MoEConfig,
     init_moe_params,
@@ -35,6 +43,9 @@ __all__ = [
     "moe_param_specs",
     "generate",
     "prefill",
+    "prefill_ragged",
     "decode_step",
     "init_kv_cache",
+    "sample_token",
+    "cached_attention",
 ]

@@ -8,6 +8,20 @@ is a mask on cached positions (not a dynamic slice), and the decode loop
 is a ``lax.scan`` — so the whole ``generate`` call jits to two compiled
 programs (prefill + scanned decode) regardless of token count.
 
+Cache lengths are **per sequence** (``cache["length"]`` is a ``(B,)``
+int32 vector): a freshly-prefilled request can join a batch of mid-decode
+sequences at a different position, which is what the continuous batcher
+(``flextree_tpu.serving``) needs.  RoPE positions and the causal mask
+honor the per-row position; cache writes go through a vmapped dynamic
+update so each row lands at its own offset.
+
+Sampling is deterministic and key-threaded (no RNG inside the trace):
+greedy is the default, ``temperature``/``top_k`` sampling requires an
+explicit ``key=``.  ``stop_tokens=`` switches the decode loop from
+``lax.scan`` to ``lax.while_loop`` so generation exits as soon as every
+sequence has emitted a stop token — the per-sequence retirement signal
+the serving batcher consumes one request at a time.
+
 Single-device by design: generation is latency-bound, and the framework's
 sharded story lives in the training steps; a tp-sharded decode would reuse
 the same cache layout with heads split over the axis.
@@ -22,20 +36,30 @@ from jax import lax
 from .transformer import (
     TransformerConfig,
     apply_rope,
+    final_logits,
     mlp_block,
     rms_norm,
 )
 
-__all__ = ["init_kv_cache", "prefill", "decode_step", "generate"]
+__all__ = [
+    "init_kv_cache",
+    "prefill",
+    "prefill_ragged",
+    "decode_step",
+    "generate",
+    "sample_token",
+    "cached_attention",
+]
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
-    """Per-layer (B, max_len, H, Dh) K/V buffers in the compute dtype."""
+    """Per-layer (B, max_len, H, Dh) K/V buffers in the compute dtype.
+    ``length`` is per-sequence (B,) so ragged batches can share a cache."""
     shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
     return {
         "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
         "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
-        "length": jnp.zeros((), jnp.int32),
+        "length": jnp.zeros((batch,), jnp.int32),
     }
 
 
@@ -48,17 +72,23 @@ def _qkv(layer, h, cfg: TransformerConfig):
     return q, k, v
 
 
-def _cached_attention(q, k_cache, v_cache, q_pos):
+def cached_attention(q, k_cache, v_cache, q_pos):
     """Attend (B, Tq, H, D) queries over cached positions ``<= q_pos``
-    (global query positions); the causal bound alone masks out every
-    not-yet-written cache slot.  Math order mirrors ``attention_reference``
-    exactly (einsum in the compute dtype, then f32) so decode logits are
-    teacher-forcing-exact in every dtype."""
+    (global query positions, (Tq,) shared or (B, Tq) per-sequence); the
+    causal bound alone masks out every not-yet-written cache slot — masked
+    scores softmax to exactly 0.0 in f32, so whatever a masked slot holds
+    contributes exactly nothing (the paged cache's gather path leans on
+    this).  Math order mirrors ``attention_reference`` exactly (einsum in
+    the compute dtype, then f32) so decode logits are teacher-forcing-exact
+    in every dtype."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32) * scale
     kpos = jnp.arange(k_cache.shape[1])
-    mask = kpos[None, :] <= q_pos[:, None]
-    s = jnp.where(mask[None, None], s, -1e30)
+    if q_pos.ndim == 1:  # shared positions: (Tq, K) mask over all rows
+        mask = (kpos[None, :] <= q_pos[:, None])[None, None]
+    else:  # per-sequence positions: (B, 1, Tq, K)
+        mask = (kpos[None, None, :] <= q_pos[:, :, None])[:, None]
+    s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
     return out.astype(q.dtype)
@@ -66,9 +96,18 @@ def _cached_attention(q, k_cache, v_cache, q_pos):
 
 def _forward_cached(params, tokens, cache, start_pos, cfg: TransformerConfig):
     """Forward ``tokens`` (B, T) writing K/V at ``start_pos..start_pos+T``;
-    returns (logits, cache).  ``start_pos`` may be traced (decode)."""
+    returns (logits, cache).  ``start_pos`` may be traced, scalar (all rows
+    at the same offset — the prefill case) or (B,) per-sequence (ragged
+    decode); the returned ``cache["length"]`` is always (B,)."""
     b, t = tokens.shape
-    positions = start_pos + jnp.arange(t)
+    start = jnp.asarray(start_pos, jnp.int32)
+    ragged = start.ndim == 1
+    positions = (start[:, None] if ragged else start) + jnp.arange(t)
+    if ragged:
+        # each row lands at its own offset: vmap the length-axis update
+        upd = jax.vmap(
+            lambda c, u, s: lax.dynamic_update_slice_in_dim(c, u, s, axis=0)
+        )
     x = params["embed"][tokens].astype(cfg.dtype)
     new_k, new_v = [], []
     for layer, kc, vc in zip(params["layers"], cache["k"], cache["v"]):
@@ -76,17 +115,21 @@ def _forward_cached(params, tokens, cache, start_pos, cfg: TransformerConfig):
         q, k, v = _qkv(layer, h, cfg)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        kc = lax.dynamic_update_slice_in_dim(kc, k, start_pos, axis=1)
-        vc = lax.dynamic_update_slice_in_dim(vc, v, start_pos, axis=1)
+        if ragged:
+            kc = upd(kc, k, start)
+            vc = upd(vc, v, start)
+        else:
+            kc = lax.dynamic_update_slice_in_dim(kc, k, start, axis=1)
+            vc = lax.dynamic_update_slice_in_dim(vc, v, start, axis=1)
         new_k.append(kc)
         new_v.append(vc)
-        attn = _cached_attention(q, kc, vc, positions)
+        attn = cached_attention(q, kc, vc, positions)
         o = attn.reshape(b, t, -1) @ layer["wo"].astype(cfg.dtype)
         x = x + o
         x = mlp_block(layer, x, cfg)
-    x = rms_norm(x, params["ln_f"])
-    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    cache = {"k": new_k, "v": new_v, "length": start_pos + t}
+    logits = final_logits(params["embed"], params["ln_f"], x)
+    length = jnp.broadcast_to(start + t, (b,)).astype(jnp.int32)
+    cache = {"k": new_k, "v": new_v, "length": length}
     return logits, cache
 
 
@@ -101,13 +144,63 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len: int):
     return logits[:, -1], cache
 
 
+def prefill_ragged(params, tokens, lengths, cfg: TransformerConfig,
+                   max_len: int):
+    """Right-padded batched prefill: row ``b`` of ``tokens`` (B, T) is
+    real up to ``lengths[b]`` and padding after.  Returns ``(logits,
+    cache)`` with ``logits[b]`` taken at row ``b``'s LAST REAL token and
+    ``cache["length"] = lengths`` — so the first decode write lands at
+    each row's own length, progressively overwriting the pad K/V, and
+    the causal mask keeps not-yet-overwritten pad entries invisible
+    (every attended position <= q_pos has been written by then).  Decoded
+    continuations are therefore exactly what each row would produce
+    alone."""
+    b, t = tokens.shape
+    if t > max_len:
+        raise ValueError(f"padded prompt length {t} exceeds max_len {max_len}")
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = _forward_cached(params, tokens, cache, 0, cfg)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    last = logits[jnp.arange(b), lengths - 1]
+    return last, {**cache, "length": lengths}
+
+
 def decode_step(params, cache, token, cfg: TransformerConfig):
-    """One decode step: ``token`` (B,) int32 at position ``cache['length']``.
-    Returns ``(logits, cache)`` for the next position."""
+    """One decode step: ``token`` (B,) int32, each row at its own position
+    ``cache['length'][b]``.  Returns ``(logits, cache)`` for the next
+    position."""
     logits, cache = _forward_cached(
         params, token[:, None], cache, cache["length"], cfg
     )
     return logits[:, 0], cache
+
+
+def sample_token(logits, *, temperature: float = 0.0, top_k: int | None = None,
+                 key=None):
+    """Next-token choice from (B, vocab) f32 logits — deterministic and
+    key-threaded, never RNG-in-trace.
+
+    ``temperature <= 0`` is greedy argmax (the default; ``key`` unused).
+    Otherwise ``key`` is required: logits are scaled by ``1/temperature``,
+    optionally truncated to the ``top_k`` highest (ties at the k-th value
+    are all kept), and sampled via ``jax.random.categorical``.  The same
+    ``(logits, key)`` always yields the same token.
+    """
+    if temperature <= 0:
+        if top_k is not None:
+            # greedy over top-k IS greedy — a silently ignored knob is the
+            # artifact-comparison hazard; fail loudly instead
+            raise ValueError("top_k requires temperature > 0")
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if key is None:
+        raise ValueError("temperature > 0 requires an explicit key=")
+    scaled = logits / temperature
+    if top_k is not None:
+        if not 1 <= top_k:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = lax.top_k(scaled, min(top_k, scaled.shape[-1]))[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
 def generate(
@@ -118,11 +211,21 @@ def generate(
     max_new_tokens: int,
     max_len: int | None = None,
     temperature: float = 0.0,
+    top_k: int | None = None,
     key=None,
+    stop_tokens=None,
+    pad_token: int = 0,
 ):
     """Greedy (``temperature=0``) or sampled continuation of ``prompt``
     (B, T) int32 -> (B, max_new_tokens) int32.  Sampling requires an
-    explicit ``key``."""
+    explicit ``key``; ``top_k`` truncates the sampled distribution.
+
+    With ``stop_tokens`` (a sequence of token ids) the decode loop becomes
+    a ``lax.while_loop`` that exits as soon as every row has emitted a
+    stop token (per-sequence early exit): rows that already stopped emit
+    ``pad_token``, and the return value becomes ``(tokens, lengths)`` with
+    ``lengths`` (B,) counting each row's real tokens (stop token included).
+    """
     b, t = prompt.shape
     if max_len is None:
         max_len = t + max_new_tokens
@@ -138,25 +241,53 @@ def generate(
     logits, cache = prefill(params, prompt, cfg, max_len)
 
     def pick(logits, k):
-        if not sampling:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(k, logits / temperature, axis=-1).astype(
-            jnp.int32
-        )
+        return sample_token(logits, temperature=temperature, top_k=top_k, key=k)
 
     keys = jax.random.split(key, max_new_tokens) if sampling else None
-    # first token comes straight from the prefill logits; the scan then
-    # decodes exactly max_new_tokens - 1 times (no trailing wasted forward)
+    # first token comes straight from the prefill logits; the loop then
+    # decodes at most max_new_tokens - 1 times (no trailing wasted forward)
     tok0 = pick(logits, keys[0] if sampling else None)
 
-    def step(carry, k):
-        tok, cache = carry
-        logits, cache = decode_step(params, cache, tok, cfg)
-        nxt = pick(logits, k)
-        return (nxt, cache), nxt
+    if stop_tokens is None:
+        def step(carry, k):
+            tok, cache = carry
+            logits, cache = decode_step(params, cache, tok, cfg)
+            nxt = pick(logits, k)
+            return (nxt, cache), nxt
 
-    xs = keys[1:] if sampling else None
-    (_, _), rest = lax.scan(
-        step, (tok0, cache), xs, length=None if sampling else max_new_tokens - 1
+        xs = keys[1:] if sampling else None
+        (_, _), rest = lax.scan(
+            step, (tok0, cache), xs,
+            length=None if sampling else max_new_tokens - 1,
+        )
+        return jnp.concatenate([tok0[:, None], rest.T], axis=1)
+
+    stop = jnp.asarray(tuple(stop_tokens), jnp.int32).reshape(-1)
+
+    def hit(tok):  # (B,) bool: did this token retire its row?
+        return (tok[:, None] == stop[None, :]).any(axis=1)
+
+    # pad-initialized so columns past an early all-rows exit read as pad
+    out0 = jnp.full((b, max_new_tokens), pad_token, jnp.int32).at[:, 0].set(tok0)
+    carry0 = (
+        jnp.int32(1), tok0, cache, hit(tok0), out0, jnp.ones((b,), jnp.int32)
     )
-    return jnp.concatenate([tok0[:, None], rest.T], axis=1)
+
+    def cond(carry):
+        i, _, _, done, _, _ = carry
+        return (i < max_new_tokens) & ~done.all()
+
+    def body(carry):
+        i, tok, cache, done, out, lens = carry
+        logits, cache = decode_step(params, cache, tok, cfg)
+        k = (
+            lax.dynamic_index_in_dim(keys, i, keepdims=False)
+            if sampling else None
+        )
+        nxt = jnp.where(done, jnp.int32(pad_token), pick(logits, k))
+        out = out.at[:, i].set(nxt)
+        lens = lens + (~done).astype(jnp.int32)
+        return (i + 1, nxt, cache, done | hit(nxt), out, lens)
+
+    _, _, _, _, out, lens = lax.while_loop(cond, body, carry0)
+    return out, lens

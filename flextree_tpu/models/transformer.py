@@ -156,12 +156,16 @@ def rms_norm(x, scale, eps: float = 1e-6):
 
 
 def apply_rope(x, positions, theta: float):
-    """Rotary embedding on (B, T, H, Dh) with global ``positions`` (T,)."""
+    """Rotary embedding on (B, T, H, Dh) with global ``positions`` — (T,)
+    shared across the batch, or (B, T) per-sequence (the ragged decode
+    batches of the serving path)."""
     half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
+    if ang.ndim == 2:
+        ang = ang[None]  # shared positions broadcast over the batch
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1

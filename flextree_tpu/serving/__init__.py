@@ -1,0 +1,62 @@
+"""Serving front-end: continuous-batching generation over a paged KV cache.
+
+The traffic-facing layer of the framework — requests in, tokens out,
+benchmarked in throughput and latency percentiles instead of step time:
+
+- :mod:`.kv_cache` — the paged/blocked KV cache: fixed-size blocks, a
+  host-side free-list allocator, per-sequence block tables; ragged
+  sequences share one static-shaped pool and the decode step stays one
+  compiled program (gather pages → batched ragged decode → scatter
+  appended K/V), bitwise-identical to the contiguous-cache ``generate``.
+- :mod:`.batcher` — the continuous batcher: FIFO request queue, admission
+  by token budget (all cache blocks reserved up front, so admitted
+  requests never hit mid-decode exhaustion), join-at-step prefill, and
+  per-sequence retirement that frees blocks immediately.
+- :mod:`.engine` — one serving replica: paged pool + batcher + the two
+  jitted programs, with per-request greedy/temperature/top-k sampling and
+  TTFT / per-token timestamps on an injectable clock.
+- :mod:`.pool` — the elastic replica pool: ``runtime.Supervisor``
+  heartbeat/lease membership over replicas, a ``StepWatchdog`` deadline
+  around each scheduling round, and drain/re-route off dead replicas so
+  the pool degrades instead of failing.
+
+Measured artifact: ``tools/bench_serving.py`` → ``BENCH_SERVING.json``
+(open-loop Poisson load; machine-checked floors).  Design notes and the
+honest limits: ``docs/SERVING.md``.
+"""
+
+from .batcher import BatcherConfig, ContinuousBatcher, Request, SeqState
+from .engine import CompletedRequest, ServingEngine
+from .kv_cache import (
+    NULL_BLOCK,
+    BlockAllocator,
+    CacheExhausted,
+    PagedCacheConfig,
+    gather_seq,
+    init_pools,
+    make_paged_decode_fn,
+    paged_decode_step,
+    write_prefill,
+)
+from .pool import PoolConfig, ReplicaFailed, ReplicaPool
+
+__all__ = [
+    "NULL_BLOCK",
+    "BlockAllocator",
+    "CacheExhausted",
+    "PagedCacheConfig",
+    "init_pools",
+    "write_prefill",
+    "paged_decode_step",
+    "make_paged_decode_fn",
+    "gather_seq",
+    "Request",
+    "SeqState",
+    "BatcherConfig",
+    "ContinuousBatcher",
+    "ServingEngine",
+    "CompletedRequest",
+    "PoolConfig",
+    "ReplicaFailed",
+    "ReplicaPool",
+]

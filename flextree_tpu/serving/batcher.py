@@ -1,0 +1,247 @@
+"""Continuous batcher: request queue, admission by token budget, slots.
+
+The batcher is a pure host-side state machine — no JAX in this module —
+so every transition is unit-testable without a device.  State:
+
+- a FIFO **queue** of submitted :class:`Request`\\ s (head-of-line order
+  is preserved; a request that does not fit blocks the ones behind it —
+  no starvation of big requests by a stream of small ones);
+- ``slots`` decode **slots**, each empty or holding a :class:`SeqState`.
+  The slot count is the compiled decode batch size S: the jitted paged
+  decode always runs S rows, empty slots ride along as masked no-ops
+  (their pool writes land in the null block).
+
+**Admission math** (``try_admit``): a request needs ``ceil((prompt_len +
+max_new_tokens) / block_size)`` cache blocks.  The batcher reserves ALL
+of them at admission — conservative (a request that stops early returns
+blocks it never wrote), but it makes mid-decode exhaustion structurally
+impossible: an admitted request always runs to retirement, so the engine
+never needs preemption/swap-out machinery.  A per-step **prefill token
+budget** caps how much prefill work joins one step, bounding the decode
+stall that admission imposes on already-running sequences
+(join-at-step: new requests prefill into free slots while running
+sequences keep decoding on the next step).
+
+**Retirement** (``retire_ready``): a sequence is done when it has emitted
+``max_new_tokens`` tokens or a token in its ``stop_tokens``.  Retirement
+frees the slot and returns every reserved block to the allocator
+immediately — freed blocks admit queued requests on the very next step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+import numpy as np
+
+from .kv_cache import BlockAllocator, CacheExhausted, PagedCacheConfig, NULL_BLOCK
+
+__all__ = ["Request", "SeqState", "BatcherConfig", "ContinuousBatcher"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request.  ``prompt`` is a 1-D int32 array;
+    ``stop_tokens`` retire the sequence early; sampling knobs mirror
+    ``models.generate`` (greedy by default, ``seed`` threads a
+    deterministic key when ``temperature > 0``)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    stop_tokens: tuple = ()
+    temperature: float = 0.0
+    top_k: int | None = None
+    seed: int | None = None
+    arrival_s: float = 0.0
+
+    @property
+    def prompt_len(self) -> int:
+        return int(np.asarray(self.prompt).shape[0])
+
+
+@dataclasses.dataclass
+class SeqState:
+    """A resident sequence: its reservation, progress, and timestamps."""
+
+    request: Request
+    block_ids: list
+    length: int  # cache positions filled (prompt + written decode tokens)
+    pending_token: int  # last emitted token, not yet written to the cache
+    generated: list  # emitted tokens, stop token included
+    done: bool = False
+    admitted_s: float = 0.0
+    first_token_s: float = 0.0
+    done_s: float = 0.0
+
+    @property
+    def rid(self) -> int:
+        return self.request.rid
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    """``slots``: the compiled decode batch size S.
+    ``max_prefill_tokens_per_step``: join-at-step budget — total prompt
+    tokens admitted per engine step (at least one request is always
+    admitted when a slot and blocks are free, so a long prompt cannot
+    deadlock itself)."""
+
+    slots: int = 4
+    max_prefill_tokens_per_step: int = 256
+
+
+class ContinuousBatcher:
+    def __init__(self, pcfg: PagedCacheConfig, bcfg: BatcherConfig):
+        self.pcfg = pcfg
+        self.bcfg = bcfg
+        self.allocator = BlockAllocator(pcfg.num_blocks)
+        self.slots: list = [None] * bcfg.slots
+        self.queue: deque = deque()
+        self.rejected: list = []  # (rid, reason) for oversized requests
+
+    # ---- intake ------------------------------------------------------------
+
+    def submit(self, request: Request) -> bool:
+        """Queue a request; oversized ones (they could NEVER be admitted)
+        are rejected now, loudly, instead of clogging the queue head."""
+        total = request.prompt_len + request.max_new_tokens
+        if request.prompt_len < 1:
+            self.rejected.append((request.rid, "empty prompt"))
+            return False
+        if total > self.pcfg.max_len:
+            self.rejected.append(
+                (request.rid,
+                 f"prompt+max_new {total} exceeds max_len {self.pcfg.max_len}")
+            )
+            return False
+        if request.temperature > 0 and request.seed is None:
+            # reject BEFORE admission: discovered mid-prefill this would
+            # wedge the slot (blocks reserved, no sampler key)
+            self.rejected.append(
+                (request.rid, "temperature > 0 requires seed=")
+            )
+            return False
+        self.queue.append(request)
+        return True
+
+    # ---- admission ---------------------------------------------------------
+
+    def blocks_needed(self, request: Request) -> int:
+        return self.pcfg.blocks_for(
+            request.prompt_len + request.max_new_tokens
+        )
+
+    def try_admit(self, now_s: float = 0.0) -> list:
+        """Admit queued requests into free slots under the block and
+        prefill-token budgets.  Returns ``[(slot_idx, SeqState), ...]``
+        for the engine to prefill; the states are already resident (the
+        reservation happened here — all-or-nothing per request)."""
+        admitted = []
+        budget = self.bcfg.max_prefill_tokens_per_step
+        while self.queue:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                break
+            req = self.queue[0]
+            if admitted and req.prompt_len > budget:
+                break  # join-at-step budget spent; next step picks it up
+            try:
+                blocks = self.allocator.alloc(self.blocks_needed(req))
+            except CacheExhausted:
+                break  # FIFO head-of-line: wait for retirements
+            self.queue.popleft()
+            budget -= req.prompt_len
+            state = SeqState(
+                request=req,
+                block_ids=blocks,
+                length=req.prompt_len,
+                pending_token=-1,
+                generated=[],
+                admitted_s=now_s,
+            )
+            slot = free_slots[0]
+            self.slots[slot] = state
+            admitted.append((slot, state))
+        return admitted
+
+    # ---- the decode-step view ---------------------------------------------
+
+    def active_slots(self) -> list:
+        """Slots holding a live, not-yet-done sequence that has a pending
+        token to write (i.e. participates in the next decode step)."""
+        return [
+            i for i, s in enumerate(self.slots)
+            if s is not None and not s.done
+        ]
+
+    def batch_arrays(self):
+        """(tables (S, P), lengths (S,), tokens (S,), active (S,)) int32 /
+        bool numpy views of the current slots — inactive rows are
+        all-NULL_BLOCK tables at length 0 with token 0 (masked no-ops)."""
+        S, P = self.bcfg.slots, self.pcfg.blocks_per_seq
+        tables = np.full((S, P), NULL_BLOCK, np.int32)
+        lengths = np.zeros((S,), np.int32)
+        tokens = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        for i, s in enumerate(self.slots):
+            if s is None or s.done:
+                continue
+            tables[i, : len(s.block_ids)] = s.block_ids
+            lengths[i] = s.length
+            tokens[i] = s.pending_token
+            active[i] = True
+        return tables, lengths, tokens, active
+
+    def record_first_token(self, slot: int, token: int, now_s: float) -> None:
+        s = self.slots[slot]
+        s.pending_token = int(token)
+        s.generated.append(int(token))
+        s.first_token_s = now_s
+        self._maybe_finish(s, now_s)
+
+    def record_decode_token(self, slot: int, token: int, now_s: float) -> None:
+        """The decode step wrote ``pending_token``'s K/V at ``length`` and
+        produced ``token`` for the next position."""
+        s = self.slots[slot]
+        s.length += 1
+        s.pending_token = int(token)
+        s.generated.append(int(token))
+        self._maybe_finish(s, now_s)
+
+    def _maybe_finish(self, s: SeqState, now_s: float) -> None:
+        hit_stop = s.generated[-1] in s.request.stop_tokens
+        if hit_stop or len(s.generated) >= s.request.max_new_tokens:
+            s.done = True
+            s.done_s = now_s
+
+    # ---- retirement --------------------------------------------------------
+
+    def retire_ready(self) -> list:
+        """Free every done slot's blocks; returns ``[(slot_idx, SeqState)]``
+        for the finished sequences."""
+        finished = []
+        for i, s in enumerate(self.slots):
+            if s is not None and s.done:
+                self.allocator.free(s.block_ids)
+                self.slots[i] = None
+                finished.append((i, s))
+        return finished
+
+    # ---- introspection -----------------------------------------------------
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(s is None for s in self.slots)
+
+    def inflight_requests(self) -> list:
+        """Every submitted-but-unfinished request — queued or resident.
+        The replica pool drains this to re-route off a dead replica."""
+        out = [s.request for s in self.slots if s is not None]
+        out.extend(self.queue)
+        return out

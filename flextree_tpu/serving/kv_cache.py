@@ -1,0 +1,265 @@
+"""Paged/blocked KV cache: ragged sequences share one static-shaped pool.
+
+The contiguous cache in ``models.generate`` allocates ``max_len`` slots
+per sequence up front; a serving batch of ragged lengths wastes most of
+that and, worse, couples every sequence's lifetime to the batch's.  The
+paged layout breaks the coupling the way vLLM's PagedAttention does:
+
+- the pool is per layer ``(num_blocks, block_size, H, Dh)`` — one static
+  shape for the whole server lifetime, so the decode step stays ONE
+  compiled program regardless of which sequences are resident;
+- each sequence owns a **block table** (a row of block ids): block
+  ``p`` of the table holds cache positions ``p*block_size ..``; tables
+  are plain int32 inputs to the jitted step, so the host can remap them
+  between steps without recompiling;
+- a host-side :class:`BlockAllocator` (LIFO free list) hands blocks out
+  at admission and takes them back at retirement — freeing is O(blocks),
+  immediate, and per sequence.
+
+Block id 0 is the **null block**: never allocated, it pads every table
+row past the sequence's reserved blocks.  Gathered null-block content is
+always beyond the causal bound, where ``cached_attention``'s mask drives
+the softmax weight to exactly 0.0 in f32 — so whatever the null block
+holds contributes exactly nothing, and the paged decode stays **bitwise
+identical** to the contiguous-cache decode (the property
+``tools/bench_serving.py`` machine-checks).
+
+The decode step is gather → batched ragged decode → scatter: gather the
+table's blocks into a per-row contiguous (S, P*block_size, H, Dh) view,
+run exactly the ``models.generate`` math (shared helpers, not copies —
+the bitwise contract depends on one definition), and scatter the newly
+produced K/V back into each row's current block at ``length %
+block_size``.  All three phases live in one jitted function with the
+pool buffers donated, so steady-state decode is two compiled programs
+total (prefill + paged decode), same as the contiguous path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.generate import _qkv, cached_attention
+from ..models.transformer import (
+    TransformerConfig,
+    apply_rope,
+    final_logits,
+    mlp_block,
+    rms_norm,
+)
+
+__all__ = [
+    "NULL_BLOCK",
+    "CacheExhausted",
+    "PagedCacheConfig",
+    "BlockAllocator",
+    "init_pools",
+    "write_prefill",
+    "paged_decode_step",
+    "make_paged_decode_fn",
+    "gather_seq",
+]
+
+#: Block id 0 is reserved: it pads table rows and is never allocated.
+NULL_BLOCK = 0
+
+
+class CacheExhausted(RuntimeError):
+    """The allocator cannot satisfy a reservation — the admission layer's
+    signal to keep the request queued.  ``code`` is the stable taxonomy
+    tag, same pattern as ``FT_INIT_TIMEOUT`` / ``FT_STEP_TIMEOUT``."""
+
+    code = "FT_CACHE_EXHAUSTED"
+
+    def __init__(self, want: int, free: int):
+        self.want, self.free = want, free
+        super().__init__(
+            f"{self.code}: need {want} cache blocks, {free} free"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedCacheConfig:
+    """Shape of the paged pool.  ``num_blocks`` counts the null block, so
+    ``num_blocks - 1`` are allocatable; ``blocks_per_seq`` is the block
+    table width P — the longest admissible sequence is ``max_len =
+    block_size * blocks_per_seq`` tokens (prompt + generated)."""
+
+    num_blocks: int
+    block_size: int = 16
+    blocks_per_seq: int = 8
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
+        if self.block_size < 1 or self.blocks_per_seq < 1:
+            raise ValueError("block_size and blocks_per_seq must be >= 1")
+
+    @property
+    def max_len(self) -> int:
+        return self.block_size * self.blocks_per_seq
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold ``tokens`` cache positions."""
+        return -(-tokens // self.block_size)
+
+
+class BlockAllocator:
+    """Host-side LIFO free list over block ids ``1..num_blocks-1``.
+
+    LIFO keeps the working set of pool pages hot; double frees and
+    foreign ids are loud errors (a silently double-freed block would be
+    handed to two sequences and corrupt both)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() yields 1 first
+        self._allocated: set[int] = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` blocks or raise :class:`CacheExhausted` (taking
+        nothing — admission is all-or-nothing per request)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise CacheExhausted(n, len(self._free))
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks) -> None:
+        blocks = list(blocks)
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(f"duplicate block ids in free(): {blocks}")
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"block {b} is not allocated (double free or foreign id)"
+                )
+        for b in blocks:
+            self._allocated.remove(b)
+            self._free.append(b)
+
+
+def init_pools(cfg: TransformerConfig, pcfg: PagedCacheConfig) -> dict:
+    """Per-layer (num_blocks, block_size, H, Dh) K/V pools, zeros in the
+    compute dtype — mirrors ``init_kv_cache``'s structure with the batch
+    and length axes folded into (block, offset)."""
+    shape = (pcfg.num_blocks, pcfg.block_size, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+    }
+
+
+def write_prefill(pools: dict, cache: dict, block_ids) -> dict:
+    """Scatter a single-sequence contiguous prefill cache into the pool.
+
+    ``cache`` is ``prefill``'s output for a batch of ONE (its per-layer
+    K/V is (1, max_len, H, Dh) with zeros past the prompt); the first
+    ``len(block_ids) * block_size`` positions land in ``block_ids`` in
+    order.  Positions past the prompt scatter zeros — the same zeros the
+    contiguous cache holds there, which the decode writes then fill in.
+    """
+    idx = jnp.asarray(block_ids, jnp.int32)
+    n = len(block_ids)
+    out_k, out_v = [], []
+    for pk, pv, kc, vc in zip(pools["k"], pools["v"], cache["k"], cache["v"]):
+        bs = pk.shape[1]
+        if kc.shape[1] < n * bs:
+            raise ValueError(
+                f"prefill cache holds {kc.shape[1]} positions, "
+                f"{n} blocks need {n * bs}"
+            )
+        out_k.append(pk.at[idx].set(kc[0, : n * bs].reshape(n, bs, *pk.shape[2:])))
+        out_v.append(pv.at[idx].set(vc[0, : n * bs].reshape(n, bs, *pv.shape[2:])))
+    return {"k": out_k, "v": out_v}
+
+
+def paged_decode_step(params, pools, tables, lengths, tokens,
+                      cfg: TransformerConfig):
+    """One decode step for S slots over the paged pool.
+
+    ``tables`` (S, P) int32 block tables, ``lengths`` (S,) int32 cache
+    positions already filled per slot, ``tokens`` (S,) int32 the token to
+    decode at each slot's position.  Returns ``(logits, pools)`` — (S,
+    vocab) f32 next-position logits and the pool with each slot's new K/V
+    scattered at ``(tables[s, lengths[s]//bs], lengths[s] % bs)``.
+
+    Inactive slots are driven with table rows of all-NULL_BLOCK and
+    length 0: their writes land in the null block and their logits are
+    garbage the host discards; active rows never reference the null block
+    below their causal bound, so pollution there is invisible (masked
+    weights are exactly 0.0 — see the module docstring).
+
+    The per-layer math calls the SAME helpers as the contiguous decode
+    (``_qkv`` / ``apply_rope`` / ``cached_attention`` / ``mlp_block`` /
+    ``final_logits``), and the gathered view has the same (S, P*bs) key
+    length the contiguous cache would — that, plus exact-zero masking, is
+    the whole bitwise-identity argument.
+    """
+    s = tokens.shape[0]
+    positions = lengths[:, None].astype(jnp.int32)  # (S, 1) per-sequence
+    bs = pools["k"][0].shape[1]
+    row = jnp.arange(s)
+    blk = tables[row, lengths // bs]  # (S,) current block per slot
+    off = lengths % bs
+    upd = jax.vmap(
+        lambda c, u, p: lax.dynamic_update_slice_in_dim(c, u, p, axis=0)
+    )
+    x = params["embed"][tokens[:, None]].astype(cfg.dtype)
+    new_k, new_v = [], []
+    for layer, pk, pv in zip(params["layers"], pools["k"], pools["v"]):
+        h = rms_norm(x, layer["ln1"])
+        q, k, v = _qkv(layer, h, cfg)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        # gather pages -> per-row contiguous (S, P*bs, H, Dh) view, with
+        # the new K/V spliced at each row's own length (the contiguous
+        # path's dynamic_update, vmapped over ragged offsets)
+        kc = upd(pk[tables].reshape(s, -1, *pk.shape[2:]), k, lengths)
+        vc = upd(pv[tables].reshape(s, -1, *pv.shape[2:]), v, lengths)
+        attn = cached_attention(q, kc, vc, positions)
+        o = attn.reshape(s, 1, -1) @ layer["wo"].astype(cfg.dtype)
+        x = x + o
+        x = mlp_block(layer, x, cfg)
+        # scatter the appended K/V back into each row's current block
+        new_k.append(pk.at[blk, off].set(k[:, 0]))
+        new_v.append(pv.at[blk, off].set(v[:, 0]))
+    logits = final_logits(params["embed"], params["ln_f"], x)
+    return logits[:, 0], {"k": new_k, "v": new_v}
+
+
+def make_paged_decode_fn(cfg: TransformerConfig, donate: bool = True):
+    """Jit ``paged_decode_step`` with the pool buffers donated (the old
+    pool is dead the moment the new one exists — donation keeps steady-
+    state decode allocation-free)."""
+    return jax.jit(
+        partial(paged_decode_step, cfg=cfg),
+        donate_argnums=(1,) if donate else (),
+    )
+
+
+def gather_seq(pools: dict, block_ids, length: int | None = None) -> dict:
+    """Test/debug helper: one sequence's contiguous K/V view — per-layer
+    (n_blocks*bs, H, Dh), truncated to ``length`` if given."""
+    idx = jnp.asarray(block_ids, jnp.int32)
+    out = {"k": [], "v": []}
+    for pk, pv in zip(pools["k"], pools["v"]):
+        k = pk[idx].reshape(-1, *pk.shape[2:])
+        v = pv[idx].reshape(-1, *pv.shape[2:])
+        if length is not None:
+            k, v = k[:length], v[:length]
+        out["k"].append(k)
+        out["v"].append(v)
+    return out

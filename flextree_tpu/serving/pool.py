@@ -1,0 +1,334 @@
+"""Elastic replica pool: serving replicas under runtime supervision.
+
+The training runtime already knows how to keep a job alive through
+mid-run failures (``flextree_tpu.runtime``: heartbeat/lease membership,
+step watchdogs, shrink-to-survivors).  Serving reuses exactly those
+pieces over a pool of :class:`~flextree_tpu.serving.engine.ServingEngine`
+replicas:
+
+- every replica runs a :class:`~flextree_tpu.runtime.Supervisor`
+  heartbeat (rank = replica index, step = scheduling rounds, EWMA = round
+  duration) into a shared directory; a
+  :class:`~flextree_tpu.runtime.MembershipView` classifies replicas
+  healthy / straggler / dead from lease age — the SAME thresholds and
+  ``_wall`` clock injection the chaos harness proved against real
+  SIGKILL/SIGSTOP;
+- each replica's scheduling round runs under a
+  :class:`~flextree_tpu.runtime.StepWatchdog` deadline, so a hung decode
+  (wedged backend, stuck compile) becomes a typed ``StepTimeout`` instead
+  of stalling the whole pool;
+- a **dead replica drains**: every request it had in flight (queued or
+  resident) goes back to the pool queue and is re-routed to a survivor —
+  the pool *degrades* (fewer replicas, longer queues) instead of failing.
+  Generated-but-undelivered tokens die with the replica; the re-routed
+  request recomputes from its prompt on the survivor (at-least-once
+  execution, exactly-once results — the pool records a completion only
+  once per request id, and greedy decoding makes the recompute
+  bit-identical).
+
+Death is declared conservatively but drains decisively: a watchdog
+timeout marks the replica *suspect*, and a suspect engine is never
+stepped again (the abandoned watchdog worker may still be executing
+inside it — re-entering would race two threads through one engine).  The
+drain fires when the lease expires or after ``max_suspect_strikes``
+grace rounds, whichever comes first; a transient stall therefore costs
+the replica (capacity lost, requests recomputed) but never corrupts it —
+the same timeout-vs-death escalation ``fit`` uses, tilted toward safety.
+
+Replicas here are in-process objects (the pool is single-host, like the
+chaos harness's launcher); the heartbeat protocol is already
+cross-process, so promoting replicas to real processes is transport work,
+not a redesign — the named follow-up in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+from . import engine as _engine_mod
+
+from ..runtime import (
+    DEAD,
+    MembershipView,
+    StepTimeout,
+    StepWatchdog,
+    Supervisor,
+    SupervisorConfig,
+)
+from ..utils.logging import get_logger
+from .batcher import Request
+from .engine import ServingEngine
+
+__all__ = ["ReplicaFailed", "PoolConfig", "ReplicaPool"]
+
+log = get_logger("flextree.serving")
+
+
+class ReplicaFailed(RuntimeError):
+    """A replica's engine raised mid-round — the crash signature (vs the
+    hang signature, which is ``StepTimeout``)."""
+
+    code = "FT_REPLICA_FAILED"
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolConfig:
+    """``heartbeat_dir`` is the shared beat directory; lease/straggler
+    budgets mirror :class:`~flextree_tpu.runtime.SupervisorConfig`.
+    ``step_timeout_s=None`` disables the watchdog (steps run inline)."""
+
+    heartbeat_dir: str
+    step_timeout_s: float | None = None
+    interval_s: float = 0.05
+    straggler_s: float = 1.0
+    lease_s: float = 3.0
+    max_suspect_strikes: int = 3
+
+
+class _Replica:
+    def __init__(self, rank: int, engine: ServingEngine, cfg: PoolConfig):
+        self.rank = rank
+        self.engine = engine
+        self.supervisor = Supervisor(
+            SupervisorConfig(
+                rank=rank,
+                dir=cfg.heartbeat_dir,
+                interval_s=cfg.interval_s,
+                straggler_s=cfg.straggler_s,
+                lease_s=cfg.lease_s,
+            )
+        ).start()
+        self.watchdog = StepWatchdog()
+        self.alive = True
+        self.strikes = 0
+        self.rounds = 0
+        self.assigned: dict = {}  # rid -> Request (the re-route copy)
+        self.fail_mode: str | None = None  # test/chaos hook
+
+    def step_once(self, timeout_s: float | None) -> None:
+        self.watchdog.run(self._round, timeout_s=timeout_s, step=self.rounds)
+
+    def _round(self):
+        if self.fail_mode == "hang":
+            # the in-process stand-in for a wedged decode: block until the
+            # watchdog abandons this worker thread
+            time.sleep(3600.0)
+        if self.fail_mode == "raise":
+            raise ReplicaFailed(
+                f"{ReplicaFailed.code}: replica {self.rank} killed"
+            )
+        t0 = time.monotonic()
+        self.engine.step()
+        self.rounds += 1
+        self.supervisor.record_step(self.rounds, time.monotonic() - t0)
+
+    def shutdown(self) -> None:
+        self.supervisor.stop()
+        self.watchdog.close()
+
+
+class ReplicaPool:
+    """Route requests over supervised replicas; degrade on death.
+
+    ``engines`` are pre-built replicas (their pool/slot configs may
+    differ); the pool owns routing, supervision, drain, and the
+    once-per-rid completion record.
+    """
+
+    def __init__(self, engines, cfg: PoolConfig):
+        if not engines:
+            raise ValueError("a replica pool needs at least one engine")
+        self.cfg = cfg
+        self.replicas = [
+            _Replica(r, eng, cfg) for r, eng in enumerate(engines)
+        ]
+        self.membership = MembershipView(
+            cfg.heartbeat_dir,
+            straggler_s=cfg.straggler_s,
+            lease_s=cfg.lease_s,
+            configured=len(self.replicas),
+        )
+        self.queue: deque = deque()
+        self.completed: dict = {}
+        self.rejected: list = []  # (rid, reason) refused by a replica
+        self.submitted = 0
+        self.reroutes = 0
+        self.kills: list = []
+
+    # ---- intake ------------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        # stamp arrival at POOL intake (same injectable clock the engines
+        # use): a re-routed request keeps its original arrival, so TTFT
+        # includes the time it sat on the dead replica
+        if request.arrival_s == 0.0:
+            request = dataclasses.replace(
+                request, arrival_s=_engine_mod._now()
+            )
+        self.queue.append(request)
+        self.submitted += 1
+
+    @property
+    def alive_replicas(self) -> list:
+        return [r for r in self.replicas if r.alive]
+
+    @property
+    def degraded(self) -> bool:
+        return any(not r.alive for r in self.replicas)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(
+            r.engine.idle for r in self.alive_replicas
+        )
+
+    # ---- chaos hook --------------------------------------------------------
+
+    def kill(self, rank: int, mode: str = "hang") -> None:
+        """Simulate replica death: its heartbeat stops (a real process
+        death's signature) and its rounds hang, raise, or — ``mode=
+        "silent"`` — keep stepping until the lease verdict (the zombie
+        whose heartbeat died first)."""
+        if mode not in ("hang", "raise", "silent"):
+            raise ValueError(f"unknown kill mode {mode!r}")
+        r = self.replicas[rank]
+        r.supervisor.stop()
+        if mode != "silent":
+            r.fail_mode = mode
+        self.kills.append({"rank": rank, "mode": mode})
+
+    # ---- the pool round ----------------------------------------------------
+
+    def _route(self) -> None:
+        """Hand queued requests to the least-loaded alive replica —
+        fewest outstanding requests, free cache blocks as the tiebreak
+        (free blocks ALONE lag reality: a routed request reserves nothing
+        until its replica's next admission pass); keep a copy for drain."""
+        while self.queue:
+            live = [r for r in self.alive_replicas if r.strikes == 0]
+            if not live:
+                return
+            req = self.queue.popleft()
+            if req.rid in self.completed:
+                continue  # re-routed twin already finished elsewhere
+            best = min(
+                live,
+                key=lambda r: (
+                    len(r.assigned),
+                    -r.engine.batcher.allocator.num_free,
+                ),
+            )
+            if not best.engine.submit(req):
+                # refused (oversized for that replica's pool, bad sampling
+                # config): record at POOL level — a silently vanished
+                # request is the one outcome a serving layer may never have
+                reason = (
+                    best.engine.batcher.rejected[-1][1]
+                    if best.engine.batcher.rejected else "rejected"
+                )
+                self.rejected.append((req.rid, reason))
+                log.warning("request %d rejected by replica %d: %s",
+                            req.rid, best.rank, reason)
+                continue
+            best.assigned[req.rid] = req
+
+    def step(self) -> None:
+        """One pool round: route, step every live replica under its
+        watchdog, harvest completions, reap the dead."""
+        self._route()
+        for r in self.alive_replicas:
+            if r.strikes > 0:
+                # suspect: the abandoned watchdog worker may still be
+                # inside engine.step — never re-enter the engine; each
+                # skipped round is a strike toward the grace limit
+                r.strikes += 1
+                continue
+            try:
+                r.step_once(self.cfg.step_timeout_s)
+            except StepTimeout:
+                r.strikes = 1
+                log.warning("replica %d round timed out; suspect", r.rank)
+            except ReplicaFailed:
+                r.strikes = self.cfg.max_suspect_strikes
+                log.warning("replica %d raised; awaiting verdict", r.rank)
+            else:
+                self._harvest(r)
+        self._reap()
+
+    def _harvest(self, r: _Replica) -> None:
+        for rid, done in list(r.engine.completed.items()):
+            if rid not in self.completed:
+                self.completed[rid] = done
+            r.engine.completed.pop(rid)
+            r.assigned.pop(rid, None)
+
+    def _reap(self) -> None:
+        """Drain the dead: lease expiry is authoritative for EVERY
+        replica (a silently-dead heartbeat means the process is gone even
+        if the in-process stand-in still steps); strike-out only for
+        suspects."""
+        status = self.membership.poll()
+        for r in self.replicas:
+            if not r.alive:
+                continue
+            peer = status.get(r.rank)
+            lease_dead = peer is not None and peer.state == DEAD
+            struck_out = r.strikes >= self.cfg.max_suspect_strikes
+            if lease_dead or struck_out:
+                self._drain(r, "lease" if lease_dead else "strikes")
+
+    def _drain(self, r: _Replica, why: str) -> None:
+        r.alive = False
+        r.supervisor.stop()
+        # completions that raced in before death still count (dict reads
+        # are GIL-atomic; the engine itself is never re-entered)
+        self._harvest(r)
+        lost = [
+            req for rid, req in r.assigned.items()
+            if rid not in self.completed
+        ]
+        for req in lost:
+            self.queue.append(req)
+        self.reroutes += len(lost)
+        log.warning(
+            "replica %d dead (%s): re-routing %d in-flight requests to "
+            "%d survivors",
+            r.rank, why, len(lost), len(self.alive_replicas),
+        )
+
+    def run_until_idle(self, max_rounds: int = 100_000) -> dict:
+        for _ in range(max_rounds):
+            if self.idle:
+                break
+            if not self.alive_replicas and self.queue:
+                raise RuntimeError(
+                    "no replicas left alive with requests still queued"
+                )
+            self.step()
+        else:
+            raise RuntimeError(f"pool not idle after {max_rounds} rounds")
+        return self.report()
+
+    def report(self) -> dict:
+        return {
+            "replicas": len(self.replicas),
+            "alive": len(self.alive_replicas),
+            "degraded": self.degraded,
+            "submitted": self.submitted,
+            "completed": len(self.completed),
+            "rejected": list(self.rejected),
+            "reroutes": self.reroutes,
+            "kills": list(self.kills),
+        }
+
+    def shutdown(self) -> None:
+        for r in self.replicas:
+            r.shutdown()
+
+    def __enter__(self) -> "ReplicaPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,544 @@
+#!/usr/bin/env python
+"""Serving A/B artifact: continuous batching + paged KV cache vs static
+batching, under an open-loop synthetic load — the PR 9 tentpole evidence.
+
+Produces ``BENCH_SERVING.json``, machine-checked with a non-zero exit on
+any violation:
+
+1. **Throughput floor**: the continuous batcher serves >= 1.3x the
+   static batcher's token throughput on the SAME open-loop arrival
+   schedule (Poisson arrivals, mixed prompt/output lengths).  The static
+   baseline is the honest industry default — fixed batch size, prompts
+   right-padded to the configured maximum, every batch decoded to the
+   configured maximum output length, arrivals queue at the batch
+   barrier — with per-row RAGGED lengths (``prefill_ragged``) so its
+   OUTPUTS are still exactly each request's own continuation (it pays
+   padding in compute, not in correctness).
+2. **Bitwise floor**: every checked request served by the continuous
+   engine (paged cache, ragged joins, shared pool) produced exactly the
+   tokens ``generate`` (contiguous cache, request alone) produces.  This
+   is checked on the REAL load run's outputs, not a side experiment.
+3. **Degrade floor**: a 2-replica pool with one replica killed mid-run
+   (hang + heartbeat stop — the watchdog/lease path) finishes EVERY
+   submitted request on the survivor: degraded, not failed, with at
+   least one re-routed request.
+
+Latency percentiles (TTFT and per-token, p50/p95/p99) are reported for
+both systems; the p99-TTFT comparison feeds ``bench.py``'s
+``serving_p99_regression`` tripwire.  Where continuous batching honestly
+cannot win — homogeneous lengths, closed-loop single client, batch-
+aligned arrivals — is documented in docs/SERVING.md; the floors here are
+for the heterogeneous open-loop regime it exists for.
+
+Usage: python tools/bench_serving.py [--smoke] [--out BENCH_SERVING.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import platform
+import sys
+import tempfile
+import time
+from collections import deque
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from flextree_tpu.models.generate import (  # noqa: E402
+    decode_step,
+    generate,
+    prefill_ragged,
+)
+from flextree_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    init_params,
+)
+from flextree_tpu.serving import (  # noqa: E402
+    BatcherConfig,
+    PagedCacheConfig,
+    PoolConfig,
+    ReplicaPool,
+    Request,
+    ServingEngine,
+)
+
+MIN_THROUGHPUT_RATIO = 1.3  # acceptance floor: continuous vs static tok/s
+PROMPT_LENS = (4, 8, 12, 16)  # the serving mix (uniform over these)
+# decode-heavy, heavy-tailed outputs: the regime continuous batching
+# exists for.  A static batch rides until its LONGEST member finishes,
+# so its decode utilization is mean/max-of-batch — at batch 8 over this
+# mix E[max] ~ 59 vs mean 23, i.e. ~2.5 row-rounds per useful token —
+# and widening the batch makes it WORSE, which is exactly why static
+# batching cannot buy throughput with width under heterogeneous traffic.
+# docs/SERVING.md spells out the mixes where continuous honestly cannot
+# win (homogeneous lengths, prefill-dominated traffic, batch-aligned
+# arrivals)
+OUT_LENS = (4, 8, 16, 64)
+# heavy-tailed: 15% long-form requests dominate every static batch's
+# ride time (E[max of 8] ~ 51 vs mean ~17, i.e. ~3 row-rounds per useful
+# token) while the continuous batcher retires the short 85% immediately
+OUT_PROBS = (0.35, 0.25, 0.25, 0.15)
+# same compiled decode width AND same KV memory on both sides: 8 slots /
+# batch 8, 640 cache positions each (8 x max_len 80 == 80 blocks x 8).
+# (Wider continuous slots on the same pool were measured and rejected:
+# this backend's round cost grows superlinearly in width, eating the
+# residency gain — the honesty note lives in docs/SERVING.md.)
+STATIC_BATCH = 8
+CONT_SLOTS = 8
+
+_now = time.monotonic
+
+
+def _model(seed: int = 0):
+    # big enough that a decode round's compute dominates both the host
+    # loop's per-step python (~0.3 ms) and the paged gather's copy
+    # traffic (~5 MB/round); at toy sizes both systems are loop-bound and
+    # the A/B measures python, not batching policy
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=256, n_heads=8, n_layers=4, d_ff=1024
+    )
+    return cfg, init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _pcfg() -> PagedCacheConfig:
+    # max_len 80 >= max prompt (16) + max out (64).  81 blocks = 1 null +
+    # 80 allocatable = 640 cache positions: EXACTLY the static baseline's
+    # KV memory (see the STATIC_BATCH/CONT_SLOTS note above)
+    return PagedCacheConfig(num_blocks=81, block_size=8, blocks_per_seq=10)
+
+
+def build_workload(seed: int, n: int, rate_rps: float) -> list:
+    """Open-loop Poisson arrivals with mixed prompt/output lengths.
+    ``arrival_s`` is the offset from the run start; the run loops honor
+    it in real time (requests arrive whether or not the server keeps
+    up — that is what open-loop means)."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n)
+    arrivals = np.cumsum(gaps)
+    out = []
+    for i in range(n):
+        t = int(rng.choice(PROMPT_LENS))
+        m = int(rng.choice(OUT_LENS, p=OUT_PROBS))
+        out.append(
+            Request(
+                rid=i,
+                prompt=rng.integers(0, 256, (t,)).astype(np.int32),
+                max_new_tokens=m,
+                arrival_s=float(arrivals[i]),
+            )
+        )
+    return out
+
+
+def _pct(xs, q) -> float:
+    return float(np.percentile(np.asarray(xs), q)) if len(xs) else 0.0
+
+
+def _latency_summary(records) -> dict:
+    ttft = [r["ttft_s"] * 1e3 for r in records]
+    ptl = [r["per_token_s"] * 1e3 for r in records if r["per_token_s"] > 0]
+    return {
+        "ttft_ms": {f"p{q}": round(_pct(ttft, q), 2) for q in (50, 95, 99)},
+        "per_token_ms": {f"p{q}": round(_pct(ptl, q), 2) for q in (50, 95, 99)},
+    }
+
+
+# ---------------------------------------------------------------- continuous
+
+
+def run_continuous(cfg, params, pcfg, requests, slots: int) -> dict:
+    eng = ServingEngine(params, cfg, pcfg, BatcherConfig(slots=slots))
+    eng.warmup(
+        sorted({r.prompt_len for r in requests}),
+        {pcfg.blocks_for(r.prompt_len + r.max_new_tokens) for r in requests},
+    )
+    pending = deque(sorted(requests, key=lambda r: r.arrival_s))
+    t0 = _now()
+    while pending or not eng.idle:
+        now = _now() - t0
+        while pending and pending[0].arrival_s <= now:
+            req = pending.popleft()
+            # absolute arrival stamp: TTFT includes queueing delay
+            eng.submit(
+                dataclasses.replace(req, arrival_s=t0 + req.arrival_s)
+            )
+        if eng.idle and pending:
+            time.sleep(min(1e-3, pending[0].arrival_s - now))
+            continue
+        eng.step()
+    makespan = _now() - t0
+    records = [
+        {
+            "rid": rid,
+            "ttft_s": done.ttft_s,
+            "per_token_s": done.per_token_s,
+            "n_tokens": done.n_tokens,
+            "tokens": done.tokens.tolist(),
+        }
+        for rid, done in sorted(eng.completed.items())
+    ]
+    tokens = sum(r["n_tokens"] for r in records)
+    return {
+        "records": records,
+        "tokens": tokens,
+        "makespan_s": round(makespan, 3),
+        "throughput_tok_s": round(tokens / makespan, 2),
+        "decode_steps": eng.decode_steps,
+        "engine_steps": eng.steps,
+        **_latency_summary(records),
+    }
+
+
+# ------------------------------------------------------------------- static
+
+
+def run_static(cfg, params, requests, batch_size: int, max_len: int) -> dict:
+    """The fixed-shape static batcher: wait for ``batch_size`` arrivals
+    (or queue drain), right-pad prompts to max(PROMPT_LENS), decode the
+    batch until its slowest member finishes (batch-level early exit — the
+    STRONGER static baseline; provisioning every batch to the global
+    maximum would be easier to beat) — ONE prefill compile and ONE decode
+    compile for the whole run, warmed before the clock starts (real
+    static serving provisions for its configured maxima the same way)."""
+    pad_t = max(PROMPT_LENS)
+    max_steps = max(OUT_LENS) - 1
+    jit_prefill = jax.jit(
+        lambda p, tok, lens: prefill_ragged(p, tok, lens, cfg, max_len)
+    )
+    # the baseline gets the same runtime treatment as the engine: its
+    # cache is donated so decode updates alias in place
+    jit_decode = jax.jit(
+        lambda p, c, tok: decode_step(p, c, tok, cfg), donate_argnums=(1,)
+    )
+    # warm both compiles off the clock
+    wtok = np.zeros((batch_size, pad_t), np.int32)
+    wlen = np.full((batch_size,), pad_t, np.int32)
+    logits, cache = jit_prefill(params, wtok, wlen)
+    jax.block_until_ready(
+        jit_decode(params, cache, np.zeros((batch_size,), np.int32))[0]
+    )
+
+    pending = deque(sorted(requests, key=lambda r: r.arrival_s))
+    queue: deque = deque()
+    records = []
+    t0 = _now()
+    while pending or queue:
+        now = _now() - t0
+        while pending and pending[0].arrival_s <= now:
+            queue.append(pending.popleft())
+        if len(queue) < batch_size and pending:
+            nxt = pending[0].arrival_s - (_now() - t0)
+            if nxt > 0:
+                time.sleep(min(1e-3, nxt))
+                continue
+        if not queue:
+            continue
+        batch = [queue.popleft() for _ in range(min(batch_size, len(queue)))]
+        toks = np.zeros((batch_size, pad_t), np.int32)
+        lens = np.full((batch_size,), pad_t, np.int32)
+        for i, r in enumerate(batch):
+            toks[i, : r.prompt_len] = r.prompt
+            lens[i] = r.prompt_len
+        logits, cache = jit_prefill(params, toks, lens)
+        logits = np.asarray(logits)
+        t_first = _now()
+        outs = [[int(np.argmax(logits[i]))] for i in range(len(batch))]
+        first_s = [t_first] * len(batch)
+        done_s = [t_first if r.max_new_tokens == 1 else 0.0 for r in batch]
+        tok = np.asarray(
+            [o[-1] for o in outs] + [0] * (batch_size - len(batch)), np.int32
+        )
+        for _ in range(max_steps):  # the batch barrier: everyone rides along
+            if all(
+                len(outs[i]) >= batch[i].max_new_tokens
+                for i in range(len(batch))
+            ):
+                break  # batch-level early exit: slowest member done
+            logits, cache = jit_decode(params, cache, tok)
+            logits = np.asarray(logits)
+            t_step = _now()
+            nxt = []
+            for i in range(batch_size):
+                if i < len(batch) and len(outs[i]) < batch[i].max_new_tokens:
+                    outs[i].append(int(np.argmax(logits[i])))
+                    if len(outs[i]) == batch[i].max_new_tokens:
+                        done_s[i] = t_step
+                nxt.append(int(np.argmax(logits[i])))
+            tok = np.asarray(nxt, np.int32)
+        for i, r in enumerate(batch):
+            n = len(outs[i])
+            records.append(
+                {
+                    "rid": r.rid,
+                    "ttft_s": first_s[i] - (t0 + r.arrival_s),
+                    "per_token_s": (
+                        (done_s[i] - first_s[i]) / (n - 1) if n > 1 else 0.0
+                    ),
+                    "n_tokens": n,
+                    "tokens": outs[i],
+                }
+            )
+    makespan = _now() - t0
+    tokens = sum(r["n_tokens"] for r in records)
+    return {
+        "records": records,
+        "tokens": tokens,
+        "makespan_s": round(makespan, 3),
+        "throughput_tok_s": round(tokens / makespan, 2),
+        "batch_size": batch_size,
+        "pad_prompt_to": pad_t,
+        "decode_steps_per_batch": max_steps,
+        **_latency_summary(records),
+    }
+
+
+# ----------------------------------------------------------------- bitwise
+
+
+def check_bitwise(cfg, params, pcfg, requests, records, cap: int) -> dict:
+    """The served tokens (paged cache, ragged joins, shared pool) vs the
+    contiguous-cache ``generate`` oracle, request by request, bitwise."""
+    by_rid = {r["rid"]: r for r in records}
+    violations, checked = 0, 0
+    for req in requests[:cap]:
+        want = np.asarray(
+            generate(
+                params,
+                jnp.asarray(req.prompt)[None],
+                cfg,
+                max_new_tokens=req.max_new_tokens,
+                max_len=pcfg.max_len,
+            )
+        )[0]
+        got = np.asarray(by_rid[req.rid]["tokens"], np.int32)
+        checked += 1
+        if not np.array_equal(got, want):
+            violations += 1
+    return {"paged_bitwise_violations": violations, "bitwise_checked": checked}
+
+
+# ------------------------------------------------------------- replica kill
+
+
+def run_replica_kill(cfg, params, pcfg, n_requests: int, seed: int) -> dict:
+    """2 supervised replicas, one killed mid-run (hang + heartbeat stop):
+    the pool must finish every submitted request on the survivor."""
+    rng = np.random.default_rng(seed)
+    reqs = [
+        Request(
+            rid=1000 + i,
+            prompt=rng.integers(0, 256, (int(rng.choice(PROMPT_LENS)),)).astype(
+                np.int32
+            ),
+            max_new_tokens=int(rng.choice(OUT_LENS[2:])),  # keep work in flight
+        )
+        for i in range(n_requests)
+    ]
+    hb = tempfile.mkdtemp(prefix="ft_serving_hb_")
+    engines = [
+        ServingEngine(params, cfg, pcfg, BatcherConfig(slots=2))
+        for _ in range(2)
+    ]
+    for e in engines:
+        e.warmup(
+            sorted({r.prompt_len for r in reqs}),
+            {pcfg.blocks_for(r.prompt_len + r.max_new_tokens) for r in reqs},
+        )
+    # lease long (5 s = 100 missed beats — a healthy replica in a busy
+    # process must never false-positive), watchdog short: the HANG path
+    # drains via strikes within ~a second; the lease only gates silent
+    # heartbeat death
+    pool = ReplicaPool(
+        engines,
+        PoolConfig(
+            heartbeat_dir=hb, step_timeout_s=1.0, lease_s=5.0,
+            interval_s=0.05, max_suspect_strikes=3,
+        ),
+    )
+    with pool:
+        for r in reqs:
+            pool.submit(r)
+        pool.step()
+        pool.step()
+        pool.kill(1, mode="hang")
+        try:
+            rep = pool.run_until_idle()
+        except RuntimeError as e:  # report the failure, don't crash the bench
+            rep = {**pool.report(), "error": str(e)}
+            return {**rep, "oracle_violations": -1, "ok": False}
+    # correctness of the degraded run, not just completion; a request
+    # MISSING from completed is itself the floor violation this scenario
+    # exists to catch — report it, never KeyError past the check
+    missing = [r.rid for r in reqs if r.rid not in pool.completed]
+    oracle_violations = 0
+    for r in reqs:
+        if r.rid in missing:
+            continue
+        want = np.asarray(
+            generate(params, jnp.asarray(r.prompt)[None], cfg,
+                     max_new_tokens=r.max_new_tokens, max_len=pcfg.max_len)
+        )[0]
+        if not np.array_equal(pool.completed[r.rid].tokens, want):
+            oracle_violations += 1
+    ok = (
+        not missing
+        and rep["completed"] == rep["submitted"] == n_requests
+        and rep["degraded"]
+        and rep["reroutes"] >= 1
+        and oracle_violations == 0
+    )
+    return {
+        **rep,
+        "missing": missing,
+        "oracle_violations": oracle_violations,
+        "ok": ok,
+    }
+
+
+# -------------------------------------------------------------------- main
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_SERVING.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small workload for CI minutes")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    t_start = _now()
+    n = 16 if args.smoke else 48
+    rate = 200.0  # rps: deliberately above capacity so makespan is
+    # compute-bound and the throughput ratio measures efficiency, not idle
+    bitwise_cap = 6 if args.smoke else 8
+    kill_requests = 6 if args.smoke else 10
+    reps = 1 if args.smoke else 2
+    slots = CONT_SLOTS
+
+    cfg, params = _model()
+    pcfg = _pcfg()
+    requests = build_workload(args.seed, n, rate)
+
+    print(f"workload: {n} requests, Poisson {rate} rps, prompts "
+          f"{PROMPT_LENS}, outputs {OUT_LENS}; continuous slots {slots} vs "
+          f"static batch {STATIC_BATCH} at equal KV memory", flush=True)
+    # interleaved (continuous, static) pairs, best-of per side: on a
+    # timeshared host a single pass swings the ratio tens of percent (the
+    # same lesson as bench.py's interleaved best-of-2 — a sustained
+    # contention episode is bounded to one pair, never one whole side)
+    conts, stats = [], []
+    for rep in range(reps):
+        cont = run_continuous(cfg, params, pcfg, requests, slots)
+        print(f"continuous[{rep}]: {cont['throughput_tok_s']} tok/s over "
+              f"{cont['makespan_s']}s, ttft {cont['ttft_ms']}", flush=True)
+        conts.append(cont)
+        stat = run_static(cfg, params, requests, batch_size=STATIC_BATCH,
+                          max_len=pcfg.max_len)
+        print(f"static[{rep}]: {stat['throughput_tok_s']} tok/s over "
+              f"{stat['makespan_s']}s, ttft {stat['ttft_ms']}", flush=True)
+        stats.append(stat)
+    cont = max(conts, key=lambda r: r["throughput_tok_s"])
+    stat = max(stats, key=lambda r: r["throughput_tok_s"])
+
+    # bitwise over EVERY continuous rep's records (a rep that served
+    # wrong tokens must not hide behind a faster twin)
+    bitwise = {"paged_bitwise_violations": 0, "bitwise_checked": 0}
+    for c in conts:
+        b = check_bitwise(cfg, params, pcfg, requests, c["records"],
+                          bitwise_cap)
+        bitwise["paged_bitwise_violations"] += b["paged_bitwise_violations"]
+        bitwise["bitwise_checked"] += b["bitwise_checked"]
+    print(f"bitwise: {bitwise}", flush=True)
+    kill = run_replica_kill(cfg, params, pcfg, kill_requests, args.seed + 1)
+    print(f"replica kill: {kill}", flush=True)
+
+    ratio = cont["throughput_tok_s"] / stat["throughput_tok_s"]
+    p99_ratio = (
+        cont["ttft_ms"]["p99"] / stat["ttft_ms"]["p99"]
+        if stat["ttft_ms"]["p99"] > 0 else 0.0
+    )
+    # the throughput floor is enforced on the full workload only: 16
+    # smoke requests = 4 static batches, and batch-alignment luck alone
+    # swings the ratio ~1.1-1.5x (observed); 48 requests average it out.
+    # Smoke still enforces the bitwise and degrade floors — the
+    # correctness gates — and reports the ratio.
+    enforce_throughput = not args.smoke
+    floors = {
+        "throughput_ratio": round(ratio, 3),
+        "min_throughput_ratio": MIN_THROUGHPUT_RATIO,
+        "throughput_floor_enforced": enforce_throughput,
+        "throughput_ok": (
+            ratio >= MIN_THROUGHPUT_RATIO if enforce_throughput else True
+        ),
+        **bitwise,
+        "bitwise_ok": bitwise["paged_bitwise_violations"] == 0,
+        "p99_ttft_ratio": round(p99_ratio, 3),
+        # regression tripwire input: continuous must not have WORSE tail
+        # TTFT than the batch-barrier baseline at equal offered load
+        "p99_regression": int(
+            cont["ttft_ms"]["p99"] > stat["ttft_ms"]["p99"]
+        ),
+        "replica_kill": kill,
+    }
+    ok = bool(
+        floors["throughput_ok"] and floors["bitwise_ok"] and kill["ok"]
+    )
+
+    doc = {
+        "bench": "serving_continuous_vs_static",
+        "smoke": bool(args.smoke),
+        "host": {
+            "platform": platform.platform(),
+            "python": sys.version.split()[0],
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+        },
+        "config": {
+            "model": f"v{cfg.vocab_size}_d{cfg.d_model}_h{cfg.n_heads}"
+            f"_L{cfg.n_layers}_ff{cfg.d_ff}_f32",
+            "paged_cache": dataclasses.asdict(pcfg),
+            "slots": slots,
+            "reps": reps,
+            "protocol": "interleaved pairs, best-of per side, bitwise on all",
+            "workload": {
+                "n_requests": n,
+                "rate_rps": rate,
+                "prompt_lens": PROMPT_LENS,
+                "out_lens": OUT_LENS,
+                "out_probs": OUT_PROBS,
+                "seed": args.seed,
+            },
+        },
+        "continuous": {k: v for k, v in cont.items() if k != "records"},
+        "static": {k: v for k, v in stat.items() if k != "records"},
+        "continuous_reps_tok_s": [c["throughput_tok_s"] for c in conts],
+        "static_reps_tok_s": [s["throughput_tok_s"] for s in stats],
+        "floors": floors,
+        "ok": ok,
+        "elapsed_s": round(_now() - t_start, 1),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"ok": ok, "throughput_ratio": floors["throughput_ratio"],
+                      "p99_ttft_ratio": floors["p99_ttft_ratio"]}))
+    if not ok:
+        print("MACHINE-CHECK FAILED; see floors in " + args.out,
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
