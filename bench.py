@@ -683,6 +683,87 @@ def run_serving_tripwire(timeout_s: int = 900) -> dict:
             pass
 
 
+_OBS_TRIPWIRE_CODE = r'''
+import json, os, sys, tempfile, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from flextree_tpu.obs import flight_recorder, merge_dir, read_dir, validate_trace
+from flextree_tpu.parallel.loop import FitConfig, Supervision, fit
+
+class D:
+    def batch_at(self, step):
+        t = np.full((2, 4), float(step + 1)); return t, t
+
+hang = {{2}}
+def step_fn(state, tokens, targets):
+    s = int(np.asarray(state["step"]))
+    if s in hang:
+        hang.discard(s); time.sleep(2.0)  # one watchdogged hang, then retry
+    return ({{"step": np.int64(s + 1), "w": np.asarray(state["w"]) - 1.0}},
+            {{"loss": 0.5}})
+
+obs = tempfile.mkdtemp()
+with flight_recorder(obs, rank=0) as rec:
+    fit({{"step": np.int64(0), "w": np.zeros(2)}}, step_fn, D(),
+        FitConfig(num_steps=5, log_every=0, prefetch=0),
+        supervision=Supervision(step_timeout_s=0.4, max_step_retries=1))
+    dump_path = rec.dump_path
+
+# the dump-guarantee floors: the failure path left its marker event, the
+# guaranteed sidecar dump, and a record that merges schema-valid
+violations = 0
+events, dumps = read_dir(obs)
+violations += not os.path.exists(dump_path)
+violations += dumps.get(0, {{}}).get("reason") != "watchdog_timeout"
+violations += not any(e["kind"] == "watchdog_timeout" for e in events)
+violations += not any(e["kind"] == "step_end" for e in events)
+violations += bool(validate_trace(merge_dir(obs)))
+
+# recorder overhead on the fused train step (same interleaved protocol
+# as the supervised row; the enforced <= 2% floor lives in
+# tools/obs_chaos.py -> OBS_CHAOS.json)
+from flextree_tpu.utils.compat import request_cpu_devices
+request_cpu_devices(8)
+from flextree_tpu.bench.harness import TrainStepBenchConfig, run_train_step_bench
+out = run_train_step_bench(
+    TrainStepBenchConfig(n_layers=2, repeat=4, supervised=False, recorder=True)
+)
+overhead = out["rows"]["ours_fused_recorded"]["recorder_overhead"]
+print("OBS_JSON: " + json.dumps({{
+    "flight_recorder_dump_violations": violations,
+    "obs_overhead_frac": round(max(overhead - 1.0, 0.0), 4),
+}}))
+'''
+
+
+def run_obs_tripwire(timeout_s: int = 300) -> dict:
+    """Supplementary keys ``flight_recorder_dump_violations`` (a
+    watchdog-timeout failure path through the real ``fit`` leaves the
+    marker event, the guaranteed sidecar dump, and a record that merges
+    into schema-valid Chrome-trace JSON on this exact tree; 0 = all
+    held) and ``obs_overhead_frac`` (recorder-on fused train step's
+    overhead fraction — informational here; the enforced <= 2% budget
+    lives in tools/obs_chaos.py -> OBS_CHAOS.json with the 2-process
+    SIGKILL evidence).  Subprocess-guarded: absent keys read as "not
+    verified", never as "clean"."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _OBS_TRIPWIRE_CODE.format(repo=REPO)],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in p.stdout.splitlines():
+            if line.startswith("OBS_JSON: "):
+                return json.loads(line[len("OBS_JSON: "):])
+        return {
+            "obs_error": f"no OBS_JSON (rc={p.returncode}); "
+            f"stderr tail: {p.stderr[-200:]}"
+        }
+    except (subprocess.SubprocessError, OSError, ValueError) as e:
+        return {"obs_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -752,6 +833,7 @@ def main() -> int:
         result.update(run_overlap_tripwire())
         result.update(run_sharded_tripwire())
         result.update(run_serving_tripwire())
+        result.update(run_obs_tripwire())
     print(json.dumps(result))
     return 0
 

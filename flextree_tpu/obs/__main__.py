@@ -1,0 +1,85 @@
+"""CLI: merge per-rank flight-recorder files into one Chrome trace.
+
+Usage::
+
+    python -m flextree_tpu.obs merge  OBS_DIR --out timeline.json
+    python -m flextree_tpu.obs validate timeline.json
+    python -m flextree_tpu.obs summary OBS_DIR
+
+``merge`` fuses every ``flight_*.jsonl`` (+ ``*.dump.json``) under
+OBS_DIR into one timeline (ranks as tracks, requests/buckets as flows)
+and validates it before writing — a merge that would not load in
+Perfetto exits non-zero.  Open the result at https://ui.perfetto.dev or
+``chrome://tracing``.  ``summary`` prints per-rank event/dump counts —
+the 10-second "what did this run leave behind".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter as _Counter
+
+from .timeline import merge_events, read_dir, validate_trace, write_trace
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="flextree_tpu.obs", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="merge an obs dir into a Chrome trace")
+    mp.add_argument("dir")
+    mp.add_argument("--out", default="timeline.json")
+    vp = sub.add_parser("validate", help="schema-check a merged trace")
+    vp.add_argument("trace")
+    sp = sub.add_parser("summary", help="per-rank event/dump counts")
+    sp.add_argument("dir")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "merge":
+        events, dumps = read_dir(args.dir)
+        if not events:
+            print(f"no flight_*.jsonl events under {args.dir}", file=sys.stderr)
+            return 1
+        doc = merge_events(events, dumps)
+        bad = validate_trace(doc)
+        if bad:
+            for b in bad:
+                print(f"invalid: {b}", file=sys.stderr)
+            return 1
+        path = write_trace(doc, args.out)
+        print(
+            f"merged {len(events)} events from {len(doc['otherData']['ranks'])} "
+            f"rank(s) ({len(dumps)} dump(s)) -> {path}"
+        )
+        return 0
+
+    if args.cmd == "validate":
+        with open(args.trace, encoding="utf-8") as f:
+            doc = json.load(f)
+        bad = validate_trace(doc)
+        for b in bad:
+            print(f"invalid: {b}", file=sys.stderr)
+        print(f"{args.trace}: {'INVALID' if bad else 'ok'} "
+              f"({len(doc.get('traceEvents', []))} trace events)")
+        return 1 if bad else 0
+
+    events, dumps = read_dir(args.dir)
+    by_rank: dict[int, _Counter] = {}
+    for ev in events:
+        by_rank.setdefault(int(ev.get("rank", 0)), _Counter())[ev["kind"]] += 1
+    for rank in sorted(by_rank):
+        kinds = ", ".join(
+            f"{k}={n}" for k, n in sorted(by_rank[rank].items())
+        )
+        dumped = dumps.get(rank)
+        tail = f"  [dump: {dumped['reason']}]" if dumped else ""
+        print(f"rank {rank}: {sum(by_rank[rank].values())} events ({kinds}){tail}")
+    if not by_rank:
+        print(f"no events under {args.dir}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

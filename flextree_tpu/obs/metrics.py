@@ -1,0 +1,221 @@
+"""Metrics registry: counters / gauges / fixed-bucket histograms.
+
+The registry replaces the ad-hoc accounting that accreted around the
+runtime and serving layers (per-request stamp lists, scattered EWMA
+plumbing, hand-rolled dict counters) with three bounded-memory
+instruments and ONE stable JSON snapshot shape, so every report —
+``run_report.json``, the serving pool report, bench artifacts — can be a
+*view* over the same numbers instead of a parallel bookkeeping path.
+
+Memory is bounded by construction: a counter/gauge is one float, a
+histogram is a fixed bucket array (values land in the bucket whose upper
+edge first contains them; an overflow bucket catches the tail) plus
+running count/sum/min/max.  Percentiles are answered from the buckets —
+exact to within one bucket's resolution, which is the honest granularity
+an always-on layer can afford (the NumPy-oracle test in
+``tests/test_obs.py`` pins the error bound).
+
+Thread-safe: instruments are updated from step loops, daemon heartbeat
+threads, and serving rounds concurrently; each mutation takes one short
+lock.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_MS_BUCKETS",
+]
+
+#: Default latency bucket upper edges (milliseconds): ~1-2-5 decades from
+#: 100 µs to 100 s — wide enough for TTFTs and train steps alike.  13
+#: buckets + overflow = bounded whatever the workload does.
+DEFAULT_MS_BUCKETS = (
+    0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+    100.0, 500.0, 1_000.0, 10_000.0, 100_000.0,
+)
+
+
+class Counter:
+    """Monotonic count.  ``inc`` rejects negative deltas — a counter that
+    can go down is a gauge wearing a costume."""
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, delta: float = 1.0) -> None:
+        if delta < 0:
+            raise ValueError(f"counter delta must be >= 0, got {delta}")
+        with self._lock:
+            self._value += delta
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def to_payload(self):
+        v = self._value
+        return int(v) if float(v).is_integer() else v
+
+
+class Gauge:
+    """Last-written value (queue depth, free blocks, alive replicas)."""
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self._value += float(delta)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def to_payload(self):
+        v = self._value
+        return int(v) if float(v).is_integer() else v
+
+
+class Histogram:
+    """Fixed-bucket histogram: ``buckets`` are increasing upper edges; an
+    implicit overflow bucket catches values past the last edge."""
+
+    def __init__(self, buckets=DEFAULT_MS_BUCKETS):
+        edges = tuple(float(b) for b in buckets)
+        if not edges or any(nxt <= prev for nxt, prev in zip(edges[1:], edges)):
+            raise ValueError(f"bucket edges must strictly increase: {edges}")
+        self.edges = edges
+        self.counts = [0] * (len(edges) + 1)  # +1: overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        # linear scan: bucket lists are ~a dozen edges and most samples
+        # land early; a bisect would save nothing measurable
+        i = 0
+        for i, edge in enumerate(self.edges):  # noqa: B007
+            if value <= edge:
+                break
+        else:
+            i = len(self.edges)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+
+    def percentile(self, q: float) -> float:
+        """The ``q``-th percentile (0..100), answered from the buckets:
+        linear interpolation inside the bucket the target rank lands in,
+        so the error is bounded by that bucket's width.  Overflow-bucket
+        answers clamp to the observed max (the one exact statistic the
+        histogram keeps past the last edge)."""
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile must be in [0, 100], got {q}")
+        if self.count == 0:
+            return math.nan
+        target = q / 100.0 * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            lo_edge = 0.0 if i == 0 else self.edges[i - 1]
+            hi_edge = self.edges[i] if i < len(self.edges) else self.max
+            if cum + c >= target:
+                frac = (target - cum) / c
+                lo = max(lo_edge, self.min if self.min is not None else lo_edge)
+                return min(lo + frac * (hi_edge - lo), hi_edge)
+            cum += c
+        return self.max if self.max is not None else math.nan
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else math.nan
+
+    def to_payload(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "min": self.min,
+            "max": self.max,
+            "mean": round(self.mean, 6) if self.count else None,
+            "p50": round(self.percentile(50), 6) if self.count else None,
+            "p95": round(self.percentile(95), 6) if self.count else None,
+            "p99": round(self.percentile(99), 6) if self.count else None,
+            "buckets": {
+                (str(e) if i < len(self.edges) else "+inf"): c
+                for i, (e, c) in enumerate(
+                    zip(self.edges + (math.inf,), self.counts)
+                )
+                if c
+            },
+        }
+
+
+class MetricsRegistry:
+    """Named instruments with create-on-first-use semantics and one
+    stable snapshot.  Asking for an existing name with a different
+    instrument kind is an error (silent shadowing is how two subsystems
+    end up disagreeing about what ``requests`` means)."""
+
+    def __init__(self):
+        self._instruments: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, kind, factory):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = factory()
+                self._instruments[name] = inst
+            elif not isinstance(inst, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, requested {kind.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge, Gauge)
+
+    def histogram(self, name: str, buckets=DEFAULT_MS_BUCKETS) -> Histogram:
+        return self._get(name, Histogram, lambda: Histogram(buckets))
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._instruments
+
+    def snapshot(self) -> dict:
+        """``{"counters": {...}, "gauges": {...}, "histograms": {...}}``
+        with sorted names — the stable JSON shape reports embed."""
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            items = sorted(self._instruments.items())
+        for name, inst in items:
+            if isinstance(inst, Counter):
+                out["counters"][name] = inst.to_payload()
+            elif isinstance(inst, Gauge):
+                out["gauges"][name] = inst.to_payload()
+            else:
+                out["histograms"][name] = inst.to_payload()
+        return out

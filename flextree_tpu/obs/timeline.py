@@ -1,0 +1,326 @@
+"""Cross-rank timeline merger: per-rank event files → one Chrome trace.
+
+Reads every ``flight_*.jsonl`` (and ``*.dump.json`` sidecar) a run left
+in its obs directory and fuses them into one JSON document loadable by
+Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``:
+
+- each **rank is a track** (trace ``pid``; the event's ``src`` — train /
+  serve / peer — names the process), with the recorder's main lane and
+  the heartbeat daemon's lane as separate ``tid``\\ s so beats don't
+  visually interleave with steps;
+- paired ``*_start``/``*_end`` kinds (steps today; any future pair works
+  by naming convention) become **complete events** (``ph: "X"``) whose
+  duration is the measured wall-time between the pair;
+- ``bucket_planned``/``bucket_fired`` comm events become spans whose
+  duration is the *planner's predicted* time and whose ``args`` carry
+  the full plan provenance (topo widths/codec/sharded + the predicted
+  ``CostBreakdown``), so predicted-vs-measured per-phase residuals can
+  be read off any run's timeline;
+- serving request lifecycles (``serve_admit`` → ``serve_retire``)
+  become **flow arrows** keyed by request id — a re-routed request's
+  arrow visibly jumps tracks;
+- everything else is an instant event carrying its fields as ``args``.
+
+Timestamps are wall-clock (the recorders stamp with ``time.time`` for
+exactly this reason); the merger rebases to the earliest event so the
+trace starts at 0 µs.  :func:`validate_trace` is the schema check the
+tests, the chaos driver, and the bench tripwire share — "loadable
+Chrome-trace JSON" is machine-checked, not assumed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+__all__ = [
+    "read_events",
+    "read_dir",
+    "merge_events",
+    "merge_dir",
+    "validate_trace",
+    "write_trace",
+]
+
+#: kinds rendered on the heartbeat lane (tid 1) instead of the main lane
+_HEARTBEAT_KINDS = frozenset({"heartbeat"})
+
+#: paired-kind suffixes → complete events
+_START_SUFFIX, _END_SUFFIX = "_start", "_end"
+
+#: comm-plan kinds rendered as predicted-duration spans
+_PLAN_KINDS = frozenset({"bucket_planned", "bucket_fired", "collective"})
+
+_META_KEYS = frozenset({"ts", "rank", "src", "seq", "kind"})
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse one JSONL event file, tolerating a torn final line (the
+    writer may have been SIGKILL'd mid-write — everything before the
+    tear is still evidence)."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # torn tail
+            if isinstance(ev, dict) and "kind" in ev and "ts" in ev:
+                out.append(ev)
+    return out
+
+
+def read_dir(dir: str) -> tuple[list[dict], dict[int, dict]]:
+    """(events, dumps-by-rank) from every flight file under ``dir``."""
+    events: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(dir, "flight_*.jsonl"))):
+        events.extend(read_events(path))
+    dumps: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(dir, "flight_*.dump.json"))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                d = json.load(f)
+            dumps[int(d["rank"])] = d
+        except (OSError, ValueError, KeyError):
+            continue
+    return events, dumps
+
+
+def _args(ev: dict) -> dict:
+    return {k: v for k, v in ev.items() if k not in _META_KEYS}
+
+
+def _pair_key(ev: dict, base: str):
+    """Identity connecting a ``*_start`` to its ``*_end``: the rank plus
+    the pair's own id — an explicit ``id`` field wins over ``step``
+    (``fit_start``/``fit_end`` share an ``id`` while their ``step``
+    fields legitimately differ: a run starts at ``start`` and ends at
+    the final step)."""
+    return (ev.get("rank", 0), base, ev.get("id", ev.get("step")))
+
+
+def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
+    """Fuse recorder events into one Chrome-trace JSON document."""
+    # defense against duplicated spill lines (a retried batch, a file
+    # read twice): identical (rank, seq, ts, kind) is the same event.
+    # ts is part of the key because seq restarts at 0 when a later
+    # process appends to the same rank's file (the resume-after-SIGTERM
+    # pattern) — those are distinct events, not duplicates.
+    seen: set = set()
+    deduped = []
+    for ev in events:
+        key = (ev.get("rank", 0), ev.get("seq"), ev["ts"], ev["kind"])
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(ev)
+    events = sorted(deduped, key=lambda e: (e["ts"], e.get("seq", 0)))
+    t0 = events[0]["ts"] if events else 0.0
+
+    def us(ts: float) -> float:
+        return round((ts - t0) * 1e6, 1)
+
+    trace: list[dict] = []
+    ranks: dict[int, str] = {}
+    open_pairs: dict = {}
+    flow_open: set = set()
+
+    for ev in events:
+        rank = int(ev.get("rank", 0))
+        ranks.setdefault(rank, str(ev.get("src", "rank")))
+        kind = str(ev["kind"])
+        tid = 1 if kind in _HEARTBEAT_KINDS else 0
+        common = {"pid": rank, "tid": tid, "ts": us(ev["ts"])}
+
+        if kind.endswith(_START_SUFFIX):
+            open_pairs[_pair_key(ev, kind[: -len(_START_SUFFIX)])] = ev
+            continue
+        if kind.endswith(_END_SUFFIX):
+            base = kind[: -len(_END_SUFFIX)]
+            start = open_pairs.pop(_pair_key(ev, base), None)
+            if start is not None:
+                pair_id = _pair_key(ev, base)[2]
+                name = base if pair_id is None else f"{base} {pair_id}"
+                trace.append(
+                    {
+                        "name": name,
+                        "cat": base,
+                        "ph": "X",
+                        **common,
+                        "ts": us(start["ts"]),
+                        "dur": max(round((ev["ts"] - start["ts"]) * 1e6, 1), 0.1),
+                        "args": {**_args(start), **_args(ev)},
+                    }
+                )
+                continue
+            # unmatched end (start predates the ring / the file): instant
+            trace.append(
+                {"name": kind, "cat": base, "ph": "i", "s": "t", **common,
+                 "args": _args(ev)}
+            )
+            continue
+
+        if kind in _PLAN_KINDS:
+            args = _args(ev)
+            dur = max(float(args.get("predicted_us") or 1.0), 1.0)
+            trace.append(
+                {
+                    "name": str(args.get("name", kind)),
+                    "cat": "comm-plan",
+                    "ph": "X",
+                    **common,
+                    "dur": round(dur, 1),
+                    "args": args,
+                }
+            )
+            continue
+
+        if kind.startswith("serve_") and "rid" in ev:
+            rid = int(ev["rid"])
+            trace.append(
+                {"name": kind, "cat": "serve", "ph": "i", "s": "t", **common,
+                 "args": _args(ev)}
+            )
+            flow = {"name": f"request {rid}", "cat": "request", "id": rid,
+                    **common}
+            if kind == "serve_admit" and rid not in flow_open:
+                flow_open.add(rid)
+                trace.append({**flow, "ph": "s"})
+            elif kind == "serve_retire" and rid in flow_open:
+                flow_open.discard(rid)
+                trace.append({**flow, "ph": "f", "bp": "e"})
+            elif rid in flow_open:
+                trace.append({**flow, "ph": "t"})
+            continue
+
+        scope = "p" if kind in ("dump", "shrink", "preempt") else "t"
+        trace.append(
+            {"name": kind, "cat": kind, "ph": "i", "s": scope, **common,
+             "args": _args(ev)}
+        )
+
+    # unmatched starts: the step a rank never finished — the cut-off
+    # moment a forensic timeline exists to show — rendered as instants
+    for (rank, base, pair_id), start in sorted(
+        open_pairs.items(), key=lambda kv: kv[1]["ts"]
+    ):
+        trace.append(
+            {
+                "name": (f"{base} {pair_id}" if pair_id is not None else base)
+                + " (unfinished)",
+                "cat": base,
+                "ph": "i",
+                "s": "p",
+                "pid": int(rank),
+                "tid": 0,
+                "ts": us(start["ts"]),
+                "args": _args(start),
+            }
+        )
+
+    # track names + dump summaries
+    for rank, src in sorted(ranks.items()):
+        trace.append(
+            {"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+             "args": {"name": f"rank {rank} ({src})"}}
+        )
+        trace.append(
+            {"name": "thread_name", "ph": "M", "pid": rank, "tid": 0,
+             "args": {"name": "events"}}
+        )
+        trace.append(
+            {"name": "thread_name", "ph": "M", "pid": rank, "tid": 1,
+             "args": {"name": "heartbeat"}}
+        )
+
+    doc = {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "flextree_tpu.obs",
+            "ranks": sorted(ranks),
+            "events": len(events),
+            "epoch_s": t0,
+            "dumps": {
+                str(r): {"reason": d.get("reason"),
+                         "events": len(d.get("events", ()))}
+                for r, d in sorted((dumps or {}).items())
+            },
+        },
+    }
+    return doc
+
+
+def merge_dir(dir: str) -> dict:
+    """Merge every per-rank flight file under ``dir``."""
+    events, dumps = read_dir(dir)
+    return merge_events(events, dumps)
+
+
+_VALID_PH = frozenset("BEXiIsMtfPNODC")
+
+
+def validate_trace(doc) -> list[str]:
+    """Schema-validity violations of a merged timeline (empty = loadable
+    Chrome-trace JSON, object format).  The checks mirror what the
+    Perfetto/catapult loaders actually require: a ``traceEvents`` list
+    whose entries carry ``name``/``ph``/``ts``/``pid``/``tid``, complete
+    events with a non-negative ``dur``, and flow starts matched by flow
+    finishes."""
+    bad: list[str] = []
+    if not isinstance(doc, dict) or not isinstance(
+        doc.get("traceEvents"), list
+    ):
+        return ["document is not a dict with a traceEvents list"]
+    flows: dict = {}
+    for i, ev in enumerate(doc["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            bad.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _VALID_PH:
+            bad.append(f"{where}: bad ph {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            bad.append(f"{where}: missing name")
+        if ph != "M":
+            for key in ("ts", "pid", "tid"):
+                if not isinstance(ev.get(key), (int, float)):
+                    bad.append(f"{where}: missing/non-numeric {key}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                bad.append(f"{where}: complete event with bad dur {dur!r}")
+        if ph in "stf":
+            if "id" not in ev:
+                bad.append(f"{where}: flow event without id")
+            elif ph != "t":
+                flows[ev["id"]] = flows.get(ev["id"], 0) + (1 if ph == "s" else -1)
+        if "args" in ev:
+            try:
+                json.dumps(ev["args"])
+            except (TypeError, ValueError):
+                bad.append(f"{where}: args not JSON-serializable")
+    for fid, n in sorted(flows.items()):
+        # an s without an f is fine (a request in flight when the rank
+        # died is exactly what a forensic timeline shows); an f that was
+        # never opened is a merger bug
+        if n < 0:
+            bad.append(f"flow id {fid}: finish without start")
+    return bad
+
+
+def write_trace(doc: dict, path: str | os.PathLike) -> str:
+    path = os.fspath(path)
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path

@@ -325,12 +325,18 @@ def _fused_compressed_bucket(leaves, axes, topos, codec, chunks, step, bi, nbyte
     the per-leaf path in ``train.sync_grads``."""
     from .compressed import compressed_allreduce, local_residual
 
+    from ..obs import bucket_provenance
+
     flats = [g.reshape(-1).astype(jnp.float32) for g in leaves]
     fused = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
     res = None
     for k, ax in enumerate(axes):
         name = f"ftq_bucket{bi}_{ax}_{len(leaves)}leaves_{nbytes}B"
-        with comm_span(name):
+        prov = bucket_provenance(
+            (ax,), topos, nbytes, n_leaves=len(leaves), codec=codec,
+            chunks=chunks,
+        )
+        with comm_span(name, provenance=prov):
             if topos[ax] is None:
                 fused = _NATIVE_PSUM(fused, ax)  # sentinel stays exact f32
             elif res is None and k == 0:
@@ -404,9 +410,15 @@ def bucketed_sync_grads(
                 for i, r in zip(b.indices, res):
                     residuals[i] = r
         else:
+            from ..obs import bucket_provenance
+
             for ax in b.axes:
                 name = f"ft_bucket{bi}_{ax}_{len(b.indices)}leaves_{b.nbytes}B"
-                with comm_span(name):
+                prov = bucket_provenance(
+                    (ax,), topos, b.nbytes, n_leaves=len(b.indices),
+                    dtype=b.dtype, chunks=chunks,
+                )
+                with comm_span(name, provenance=prov):
                     if topos[ax] is None:
                         leaves = _fused_native_psum(leaves, ax)
                     else:

@@ -52,6 +52,7 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from ..obs import dump_current, get_registry, record_event
 from ..utils.checkpoint import (
     latest_checkpoint,
     restore_train_state,
@@ -173,6 +174,10 @@ class RunReport:
     membership_epochs: list = dataclasses.field(default_factory=list)
     preempted_at: int | None = None  # step the SIGTERM checkpoint ran at
     background_saves: int = 0  # off-step-path checkpoint writes
+    # the ambient obs registry's snapshot (None when the run carried no
+    # telemetry): run_report.json is then a VIEW over the same counters /
+    # histograms the flight recorder's metrics export carries
+    metrics: dict | None = None
 
     def to_payload(self) -> dict:
         return dataclasses.asdict(self)
@@ -335,6 +340,9 @@ def fit(
         def _feed_supervisor(dur_s):
             if sup.supervisor is not None:
                 sup.supervisor.record_step(step, dur_s)
+            reg = get_registry()
+            if reg is not None:
+                reg.histogram("train.step_ms").observe(dur_s * 1e3)
 
         def _materialized_step(st, tk, tg):
             # JAX dispatch is async: a jitted step returns unmaterialized
@@ -414,6 +422,13 @@ def fit(
                     "dead": list(new_dead),
                 }
             )
+            record_event(
+                "shrink", step=at_step, dead=list(new_dead), alive=n_alive,
+                configured=prev_world, topo=plan.to_ft_topo(),
+            )
+            # the forensic record of WHAT the survivor saw around the
+            # death: ring context + the shrink decision, guaranteed
+            dump_current("peer_shrink", step=at_step, dead=list(new_dead))
             batches = _batches(step)
 
         def _membership_tick(at_step) -> str:
@@ -429,6 +444,7 @@ def fit(
                 if st == "straggler" and r not in flagged_stragglers:
                     flagged_stragglers.add(r)
                     report.stragglers.append({"rank": r, "step": at_step})
+                    record_event("straggler", peer=r, step=at_step)
                     log.warning(
                         "rank %d classified straggler at step %d", r, at_step
                     )
@@ -463,6 +479,12 @@ def fit(
                     }
                 )
 
+    # id pairs fit_start with fit_end in the merged timeline (their step
+    # fields legitimately differ: the run starts at `start`, ends later)
+    record_event(
+        "fit_start", id=start, step=start, num_steps=cfg.num_steps,
+        resumed_from=resumed_from,
+    )
     try:
         while step < cfg.num_steps:
             if sup is not None:
@@ -477,6 +499,8 @@ def fit(
                             max_to_keep=cfg.max_to_keep,
                         )
                     report.preempted_at = step
+                    record_event("preempt", step=step)
+                    dump_current("preempted", step=step)
                     log.warning(
                         "preemption: checkpointed at step %d, exiting", step
                     )
@@ -490,6 +514,7 @@ def fit(
             tokens, targets = (
                 next(batches) if batches is not None else dataset.batch_at(step)
             )
+            record_event("step_start", step=step)
             if sup is None:
                 new_state, metrics = cur_step_fn(state, tokens, targets)
             else:
@@ -506,6 +531,10 @@ def fit(
                 except StepTimeout as e:
                     report.step_timeouts += 1
                     log.warning("%s", e)
+                    # the watchdog recorded the timeout event; the dump is
+                    # fit's to guarantee — this is a failure path even when
+                    # the retry below saves the run
+                    dump_current("watchdog_timeout", step=step)
                     batches = _batches(step)  # reseek: the batch was consumed
                     if _membership_tick(step) == "shrunk":
                         timeout_retries = 0
@@ -520,10 +549,12 @@ def fit(
                         continue
                     raise
                 timeout_retries = 0
+            record_event("step_end", step=step)
             if cfg.nan_guard and not _metrics_finite(metrics):
                 report.anomalies += 1
                 report.skipped_steps.append(step)
                 bad_streak += 1
+                record_event("nan_skip", step=step, streak=bad_streak)
                 log.warning(
                     "step %d: non-finite loss/grad (%d consecutive) — update skipped",
                     step, bad_streak,
@@ -543,10 +574,12 @@ def fit(
                         # never race an in-flight background save's rotation
                         # with the restore (the saver forbids two writers)
                         _drained_saves(timeout=None)
+                    dump_current("nan_rewind", step=step)  # pre-rewind context
                     state = _restore()
                     report.rewinds += 1
                     bad_streak = 0
                     step = int(np.asarray(jax.device_get(state["step"])))
+                    record_event("nan_rewind", step=step)
                     log.warning("rewound to checkpointed step %d", step)
                     batches = _batches(step)
                     continue
@@ -589,6 +622,22 @@ def fit(
                 sup.supervisor.stop()
             if watchdog is not None:
                 watchdog.close()
+        # mirror the recovery accounting into the ambient registry (when
+        # telemetry is on) and embed its snapshot: run_report.json becomes
+        # a view over the same counters the obs metrics export carries
+        reg = get_registry()
+        if reg is not None:
+            reg.counter("train.steps").inc(max(step - start, 0))
+            reg.counter("train.anomalies").inc(report.anomalies)
+            reg.counter("train.rewinds").inc(report.rewinds)
+            reg.counter("train.step_timeouts").inc(report.step_timeouts)
+            reg.counter("train.shrinks").inc(
+                max(len(report.membership_epochs) - 1, 0)
+            )
+            reg.counter("train.background_saves").inc(report.background_saves)
+            reg.gauge("train.last_step").set(step)
+            report.metrics = reg.snapshot()
+        record_event("fit_end", id=start, step=step)
         # the accounting matters MOST for runs that die (a TrainingDiverged
         # postmortem needs the anomaly/rewind trail) — write it regardless
         if cfg.ckpt_dir:

@@ -249,9 +249,18 @@ def _sync_fired_bucket(
     collective loses both the cache-locality win the serial path already
     banked (``bucketing.CPU_MAX_BUCKET_BYTES``) and the fine-grained
     interleaving the scheduler needs to hide wire time under compute."""
+    from ..obs import bucket_provenance
     from .train import _sync_codec, sync_grads
 
     codec = _sync_codec(train_cfg)
+    from ..utils.profiling import span_bytes
+
+    prov = bucket_provenance(
+        mesh_axes, topos, span_bytes(name) or 0,
+        codec=codec if codec.lossy else None,
+        chunks=train_cfg.grad_chunks, sharded=zero_layout is not None,
+        fired=True,
+    )
     if zero_layout is not None:
         # ZeRO composition: the fired bucket REDUCE-SCATTERS at readiness
         # (wire-compressed; EF semantics identical) — the optimizer shard
@@ -261,7 +270,7 @@ def _sync_fired_bucket(
         # cannot disagree with the step's global ZeroLayout).
         from .zero import zero_reduce_scatter_grads
 
-        with comm_span(name):
+        with comm_span(name, provenance=prov):
             if not codec.lossy:
                 return (
                     zero_reduce_scatter_grads(
@@ -278,7 +287,7 @@ def _sync_fired_bucket(
                 bucket_bytes=train_cfg.bucket_bytes,
                 codec=codec, step=step, return_residual=True,
             )
-    with comm_span(name):
+    with comm_span(name, provenance=prov):
         if not codec.lossy:
             return (
                 sync_grads(

@@ -162,6 +162,12 @@ class Supervisor:
             "wall": _wall(),
             "beats": self._beats,
         }
+        from ..obs import record_event
+
+        record_event(
+            "heartbeat", hb_rank=self.cfg.rank, step=self._step,
+            ewma_ms=self._ewma_ms, beats=self._beats,
+        )
         fd, tmp = tempfile.mkstemp(dir=self.cfg.dir, suffix=".beat.tmp")
         try:
             with os.fdopen(fd, "w") as f:
@@ -239,6 +245,7 @@ class MembershipView:
         self.lease_s = lease_s
         self.ewma_factor = ewma_factor
         self._seen: dict[int, dict] = {}
+        self._last_states: dict[int, str] = {}  # lease-event edge detector
         if configured:
             for r in range(configured):
                 self._seen.setdefault(r, {})
@@ -311,6 +318,20 @@ class MembershipView:
                 rank, state, age, int(beat.get("step", -1)), ewma,
                 beat.get("pid"),
             )
+        from ..obs import record_event
+
+        for rank, status in out.items():
+            prev = self._last_states.get(rank)
+            if status.state != prev:
+                self._last_states[rank] = status.state
+                # classification EDGES only: a healthy 100-step run logs
+                # one lease event per peer, not one per poll
+                age = status.age_s
+                record_event(
+                    "lease", peer=rank, state=status.state, prev=prev,
+                    age_s=round(age, 3) if age != float("inf") else None,
+                    peer_step=status.step,
+                )
         return out
 
     # convenience filters over one poll -------------------------------------

@@ -107,6 +107,12 @@ class StepWatchdog:
             # let a future run() start clean
             self._worker = None
             self.abandoned += 1
+            from ..obs import record_event
+
+            record_event(
+                "watchdog_timeout", step=step, timeout_s=timeout_s,
+                abandoned=self.abandoned,
+            )
             raise StepTimeout(step, timeout_s) from None
         if status == "err":
             raise value

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..models.generate import prefill, sample_token
 from ..models.transformer import TransformerConfig
+from ..obs import MetricsRegistry, record_event
 from .batcher import BatcherConfig, ContinuousBatcher, Request, SeqState
 from .kv_cache import (
     PagedCacheConfig,
@@ -88,11 +89,17 @@ class ServingEngine:
         cfg: TransformerConfig,
         pcfg: PagedCacheConfig,
         bcfg: BatcherConfig | None = None,
+        metrics: MetricsRegistry | None = None,
     ):
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg
         self.bcfg = bcfg or BatcherConfig()
+        # the engine's accounting lives in a metrics registry (shareable —
+        # the replica pool passes one per replica so its report is a view
+        # over the same counters); per-request timestamps stay on
+        # CompletedRequest, the registry carries the aggregates
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.batcher = ContinuousBatcher(pcfg, self.bcfg)
         self.pools = init_pools(cfg, pcfg)
         # donation keeps steady-state decode allocation-free: the pool
@@ -115,7 +122,16 @@ class ServingEngine:
         """Queue a request (stamping arrival if the caller didn't)."""
         if request.arrival_s == 0.0:
             request = dataclasses.replace(request, arrival_s=_now())
-        return self.batcher.submit(request)
+        ok = self.batcher.submit(request)
+        self.metrics.counter(
+            "serve.submitted" if ok else "serve.rejected"
+        ).inc()
+        if not ok:
+            record_event(
+                "serve_reject", rid=request.rid,
+                reason=self.batcher.rejected[-1][1],
+            )
+        return ok
 
     @property
     def idle(self) -> bool:
@@ -125,8 +141,14 @@ class ServingEngine:
 
     def step(self) -> dict:
         """One admit → decode → retire round; returns counters."""
-        admitted = self.batcher.try_admit(_now())
+        t0 = _now()
+        admitted = self.batcher.try_admit(t0)
         for slot, state in admitted:
+            record_event(
+                "serve_admit", rid=state.rid, slot=slot,
+                prompt_len=state.request.prompt_len,
+                blocks=len(state.block_ids),
+            )
             self._prefill_slot(slot, state)
         active = self.batcher.active_slots()
         if active:
@@ -140,11 +162,20 @@ class ServingEngine:
                 tok = self._pick(slot, logits[slot])
                 self.batcher.record_decode_token(slot, tok, now)
             self.decode_steps += 1
+            self.metrics.counter("serve.decode_tokens").inc(len(active))
+            record_event("serve_decode", n_active=len(active))
         finished = self.batcher.retire_ready()
         for slot, state in finished:
             self._keys.pop(slot, None)
             self._complete(state)
         self.steps += 1
+        m = self.metrics
+        m.counter("serve.rounds").inc()
+        m.counter("serve.admitted").inc(len(admitted))
+        m.counter("serve.finished").inc(len(finished))
+        m.gauge("serve.active_slots").set(self.batcher.num_active)
+        m.gauge("serve.free_blocks").set(self.batcher.allocator.num_free)
+        m.histogram("serve.round_ms").observe((_now() - t0) * 1e3)
         return {
             "admitted": len(admitted),
             "decoded": len(active),
@@ -178,7 +209,13 @@ class ServingEngine:
                 jax.random.PRNGKey(req.seed), req.max_new_tokens
             )
         tok = self._pick(slot, np.asarray(logits[0]))
-        self.batcher.record_first_token(slot, tok, _now())
+        now = _now()
+        self.batcher.record_first_token(slot, tok, now)
+        self.metrics.histogram("serve.ttft_ms").observe(
+            (now - req.arrival_s) * 1e3
+        )
+        record_event("serve_prefill", rid=req.rid, slot=slot,
+                     prompt_len=req.prompt_len)
 
     def _pick(self, slot: int, logits_row: np.ndarray) -> int:
         state = self.batcher.slots[slot]
@@ -195,7 +232,7 @@ class ServingEngine:
         return int(np.asarray(tok)[0])
 
     def _complete(self, state: SeqState) -> None:
-        self.completed[state.rid] = CompletedRequest(
+        done = CompletedRequest(
             rid=state.rid,
             tokens=np.asarray(state.generated, np.int32),
             arrival_s=state.request.arrival_s,
@@ -203,6 +240,24 @@ class ServingEngine:
             first_token_s=state.first_token_s,
             done_s=state.done_s,
         )
+        self.completed[state.rid] = done
+        if done.n_tokens > 1:
+            self.metrics.histogram("serve.per_token_ms").observe(
+                done.per_token_s * 1e3
+            )
+        record_event("serve_retire", rid=state.rid, n_tokens=done.n_tokens,
+                     ttft_ms=round(done.ttft_s * 1e3, 3))
+
+    def report(self) -> dict:
+        """The replica's accounting: a VIEW over its metrics registry
+        (one snapshot — counters, gauges, TTFT/round-time histograms)
+        plus the loop counters the pool reads directly."""
+        return {
+            "steps": self.steps,
+            "decode_steps": self.decode_steps,
+            "completed": len(self.completed),
+            **self.metrics.snapshot(),
+        }
 
     # ---- warmup ------------------------------------------------------------
 

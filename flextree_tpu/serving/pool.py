@@ -49,6 +49,7 @@ from collections import deque
 
 from . import engine as _engine_mod
 
+from ..obs import MetricsRegistry, dump_current, record_event
 from ..runtime import (
     DEAD,
     MembershipView,
@@ -153,9 +154,19 @@ class ReplicaPool:
         self.queue: deque = deque()
         self.completed: dict = {}
         self.rejected: list = []  # (rid, reason) refused by a replica
-        self.submitted = 0
-        self.reroutes = 0
         self.kills: list = []
+        # pool-level accounting lives in a registry; report() is a view
+        # over its snapshot, and the legacy attributes below are
+        # properties reading the same counters (one bookkeeping path)
+        self.metrics = MetricsRegistry()
+
+    @property
+    def submitted(self) -> int:
+        return int(self.metrics.counter("pool.submitted").value)
+
+    @property
+    def reroutes(self) -> int:
+        return int(self.metrics.counter("pool.reroutes").value)
 
     # ---- intake ------------------------------------------------------------
 
@@ -168,7 +179,8 @@ class ReplicaPool:
                 request, arrival_s=_engine_mod._now()
             )
         self.queue.append(request)
-        self.submitted += 1
+        self.metrics.counter("pool.submitted").inc()
+        self.metrics.gauge("pool.queue_depth").set(len(self.queue))
 
     @property
     def alive_replicas(self) -> list:
@@ -198,6 +210,7 @@ class ReplicaPool:
         if mode != "silent":
             r.fail_mode = mode
         self.kills.append({"rank": rank, "mode": mode})
+        record_event("kill", replica=rank, mode=mode)
 
     # ---- the pool round ----------------------------------------------------
 
@@ -229,10 +242,12 @@ class ReplicaPool:
                     if best.engine.batcher.rejected else "rejected"
                 )
                 self.rejected.append((req.rid, reason))
+                self.metrics.counter("pool.rejected").inc()
                 log.warning("request %d rejected by replica %d: %s",
                             req.rid, best.rank, reason)
                 continue
             best.assigned[req.rid] = req
+        self.metrics.gauge("pool.queue_depth").set(len(self.queue))
 
     def step(self) -> None:
         """One pool round: route, step every live replica under its
@@ -249,9 +264,11 @@ class ReplicaPool:
                 r.step_once(self.cfg.step_timeout_s)
             except StepTimeout:
                 r.strikes = 1
+                record_event("replica_suspect", replica=r.rank, why="timeout")
                 log.warning("replica %d round timed out; suspect", r.rank)
             except ReplicaFailed:
                 r.strikes = self.cfg.max_suspect_strikes
+                record_event("replica_suspect", replica=r.rank, why="raise")
                 log.warning("replica %d raised; awaiting verdict", r.rank)
             else:
                 self._harvest(r)
@@ -291,7 +308,15 @@ class ReplicaPool:
         ]
         for req in lost:
             self.queue.append(req)
-        self.reroutes += len(lost)
+        self.metrics.counter("pool.reroutes").inc(len(lost))
+        self.metrics.counter("pool.drains").inc()
+        record_event(
+            "drain", replica=r.rank, why=why, rerouted=[q.rid for q in lost],
+            survivors=len(self.alive_replicas),
+        )
+        # engine strike-out / lease death is a failure path: guarantee the
+        # forensic dump (ring context incl. the suspect/kill events)
+        dump_current(f"replica_{why}", replica=r.rank, rerouted=len(lost))
         log.warning(
             "replica %d dead (%s): re-routing %d in-flight requests to "
             "%d survivors",
@@ -312,6 +337,10 @@ class ReplicaPool:
         return self.report()
 
     def report(self) -> dict:
+        """The pool's accounting — a view over its metrics registry (the
+        legacy keys read the same counters) plus each replica engine's
+        own registry snapshot."""
+        self.metrics.gauge("pool.alive").set(len(self.alive_replicas))
         return {
             "replicas": len(self.replicas),
             "alive": len(self.alive_replicas),
@@ -321,6 +350,10 @@ class ReplicaPool:
             "rejected": list(self.rejected),
             "reroutes": self.reroutes,
             "kills": list(self.kills),
+            "metrics": self.metrics.snapshot(),
+            "replica_metrics": {
+                r.rank: r.engine.report() for r in self.replicas
+            },
         }
 
     def shutdown(self) -> None:

@@ -18,6 +18,7 @@ with the FlexTree gradient sync, checkpoint and resume.  Examples::
 from __future__ import annotations
 
 import argparse
+import os
 
 
 def build(args):
@@ -301,6 +302,20 @@ def main(argv=None) -> int:
         help="disable the SIGTERM 'checkpoint now' fast path (on by "
         "default whenever --ckpt-dir is set)",
     )
+    # telemetry (flextree_tpu.obs; docs/OBSERVABILITY.md)
+    ap.add_argument(
+        "--obs-dir", type=str, default=None, metavar="DIR",
+        help="write this rank's flight-recorder events "
+        "(flight_{rank}.jsonl), failure dumps and metrics snapshot under "
+        "DIR; merge a run's ranks with `python -m flextree_tpu.obs merge "
+        "DIR` into one Perfetto-loadable timeline",
+    )
+    ap.add_argument(
+        "--flight-recorder", action="store_true",
+        help="enable the flight recorder with a default directory "
+        "({--ckpt-dir}/obs, or ./ft_obs without a checkpoint dir); "
+        "equivalent to --obs-dir with that path",
+    )
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -343,34 +358,55 @@ def main(argv=None) -> int:
             preemption=PreemptionGuard().install() if want_preempt else None,
         )
 
-    state, step_fn, mesh, sspecs, state_pack, state_unpack = build(args)
-    dataset = LMDataset(
-        synthetic_tokens(args.corpus_tokens, args.vocab, seed=args.seed),
-        batch=args.batch,
-        seq_len=args.seq_len,
-        seed=args.seed,
-    )
-    try:
-        result = fit(
-            state,
-            step_fn,
-            dataset,
-            FitConfig(
-                num_steps=args.steps,
-                ckpt_dir=args.ckpt_dir,
-                ckpt_every=args.ckpt_every,
-                log_every=args.log_every,
-                resume=not args.no_resume,
-            ),
-            mesh=mesh,
-            state_specs=sspecs,
-            supervision=supervision,
-            state_pack=state_pack,
-            state_unpack=state_unpack,
+    # flight recorder: installed BEFORE build so compile-time events
+    # (bucket plans with provenance) land in the record too
+    import contextlib
+
+    obs_ctx = contextlib.nullcontext()
+    if args.obs_dir or args.flight_recorder:
+        from .obs import flight_recorder, install_signal_dump
+
+        obs_dir = args.obs_dir or (
+            os.path.join(args.ckpt_dir, "obs") if args.ckpt_dir else "ft_obs"
         )
-    finally:
-        if supervision is not None and supervision.preemption is not None:
-            supervision.preemption.uninstall()  # in-process callers (tests)
+        obs_ctx = flight_recorder(obs_dir, rank=args.heartbeat_rank)
+
+    with obs_ctx as obs_rec:
+        if obs_rec is not None and (
+            supervision is None or supervision.preemption is None
+        ):
+            # no PreemptionGuard routing SIGTERM through fit's dump path:
+            # chain a flush+dump onto the default handler so even a bare
+            # terminate leaves the forensic record
+            install_signal_dump(obs_rec)
+        state, step_fn, mesh, sspecs, state_pack, state_unpack = build(args)
+        dataset = LMDataset(
+            synthetic_tokens(args.corpus_tokens, args.vocab, seed=args.seed),
+            batch=args.batch,
+            seq_len=args.seq_len,
+            seed=args.seed,
+        )
+        try:
+            result = fit(
+                state,
+                step_fn,
+                dataset,
+                FitConfig(
+                    num_steps=args.steps,
+                    ckpt_dir=args.ckpt_dir,
+                    ckpt_every=args.ckpt_every,
+                    log_every=args.log_every,
+                    resume=not args.no_resume,
+                ),
+                mesh=mesh,
+                state_specs=sspecs,
+                supervision=supervision,
+                state_pack=state_pack,
+                state_unpack=state_unpack,
+            )
+        finally:
+            if supervision is not None and supervision.preemption is not None:
+                supervision.preemption.uninstall()  # in-process callers (tests)
     first = result.losses[0][1] if result.losses else float("nan")
     last = result.losses[-1][1] if result.losses else float("nan")
     print(

@@ -6,40 +6,77 @@ and writes per-run timing files named
 (``benchmark.cpp:193-213``).  We keep the same file-name scheme (so tooling
 built for the reference's outputs keeps working) but write JSON payloads,
 and use stdlib logging with an explicit process-0 gate instead of glog.
+
+Multi-process attribution: when ``FT_RANK`` is set (the chaos drivers and
+real-process launchers export it) every log line carries an ``r{rank}``
+field, so interleaved chaos logs are attributable without grepping PIDs;
+``get_logger(rank=...)`` forces it for in-process callers (the serving
+pool's replicas).
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
 import time
 from pathlib import Path
 
-__all__ = ["get_logger", "result_file_name", "write_result_file"]
+__all__ = ["get_logger", "logger_rank", "result_file_name", "write_result_file"]
 
 _FMT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
+_FMT_RANK = "%(asctime)s %(levelname).1s r{rank} %(name)s] %(message)s"
 
 
-def get_logger(name: str = "flextree") -> logging.Logger:
+def logger_rank() -> int | None:
+    """The rank the process-wide loggers should stamp, from ``FT_RANK``
+    (exported by the multi-process launchers/chaos drivers).  None when
+    unset or unparsable — a single-process run stays unstamped."""
+    raw = os.environ.get("FT_RANK", "").strip()
+    try:
+        return int(raw) if raw else None
+    except ValueError:
+        return None
+
+
+def get_logger(name: str = "flextree", rank: int | None = None) -> logging.Logger:
+    """A configured logger.  ``rank`` (or ambient ``FT_RANK``) adds an
+    ``r{rank}`` field to the format — resolved when the logger's handler
+    is FIRST built, matching the launcher contract that ``FT_RANK`` is
+    exported before the child imports anything."""
     logger = logging.getLogger(name)
     if not logger.handlers:
+        if rank is None:
+            rank = logger_rank()
+        fmt = _FMT if rank is None else _FMT_RANK.format(rank=rank)
         h = logging.StreamHandler()
-        h.setFormatter(logging.Formatter(_FMT))
+        h.setFormatter(logging.Formatter(fmt))
         logger.addHandler(h)
         logger.setLevel(os.environ.get("FT_LOG_LEVEL", "INFO"))
         logger.propagate = False
     return logger
 
 
+# per-process monotonic disambiguator for result file names: two results
+# written in the same wall-clock second must never collide (the reference
+# scheme's silent-overwrite hazard), and a counter is collision-free where
+# a finer timestamp would only shrink the window
+_result_seq = itertools.count()
+
+
 def result_file_name(
     tag: str, num_devices: int, size: int, topo: str, comm_test: bool = False
 ) -> str:
-    """``{tag}.{N}.{size}.{topo}.{ar_test|comm_test}.{unix_time}.json`` —
-    the reference's scheme (``benchmark.cpp:196-200``) with a json suffix."""
+    """``{tag}.{N}.{size}.{topo}.{ar_test|comm_test}.{unix_time}-{seq}.json``
+    — the reference's scheme (``benchmark.cpp:196-200``) with a json
+    suffix and a monotonic per-process sequence number appended to the
+    timestamp field (same dotted-field positions, so field-indexed
+    tooling keeps working)."""
     kind = "comm_test" if comm_test else "ar_test"
     topo_s = topo.replace(",", "-").replace("*", "-") or "flat"
-    return f"{tag}.{num_devices}.{size}.{topo_s}.{kind}.{int(time.time())}.json"
+    stamp = f"{int(time.time())}-{next(_result_seq):04d}"
+    return f"{tag}.{num_devices}.{size}.{topo_s}.{kind}.{stamp}.json"
 
 
 def write_result_file(path: str | Path, payload: dict) -> Path:

@@ -1,34 +1,29 @@
-"""Profiling / tracing: the TPU-native rebuild of the reference's tracing
-scaffolding.
+"""Profiling / tracing: named spans, phase timers, span ledgers.
 
-The reference has two compile-time knobs (SURVEY §2.1 R13, §5):
-
-- ``SHOW_TIME`` — wall-clock deltas at phase boundaries via ``MPI_Wtime``
-  (``mpi_mod.hpp:34-38, 977, 1031, 1062``);
-- ``FT_DEBUG`` — verbose per-block send/recv/reduce traces
-  (``mpi_mod.hpp:686, 737, 807``).
-
-Here both become runtime facilities:
+Three layers, host-side unless noted:
 
 - :func:`trace` wraps ``jax.profiler`` so a benchmark run produces a
   TensorBoard-loadable trace; the per-stage ``jax.named_scope`` annotations
   inside :mod:`flextree_tpu.parallel.allreduce` (``ft_rs_stage*`` /
-  ``ft_ag_stage*``) make the hierarchical phases visible in it — the
-  ``SHOW_TIME`` analog, but per-op on-device rather than host wall-clock.
-- :func:`phase_timer` is the in-process ``SHOW_TIME`` fallback when a full
-  profiler trace is overkill: named checkpoints with deltas, rank-0 gated
-  logging.
-- :func:`debug_dump_schedule` is the ``FT_DEBUG`` analog: a per-rank ASCII
-  dump of the full send/recv schedule (delegating to
-  ``flextree_tpu.schedule.plan.format_plan``), driven by the ``FT_DEBUG``
-  env var so the reference's workflow (rebuild with ``-DFT_DEBUG``) becomes
-  "set ``FT_DEBUG=1``".
+  ``ft_ag_stage*``) make the hierarchical phases visible in it.
+- :func:`phase_timer` is the in-process fallback when a full profiler
+  trace is overkill: named checkpoints with deltas, rank-0 gated logging.
+- :func:`comm_span` names each bucket's collectives; at trace time it
+  feeds every active :class:`SpanLedger` *and* the ambient flight
+  recorder (:mod:`flextree_tpu.obs`), carrying plan provenance when the
+  caller supplies it — the always-on telemetry layer's view of the comm
+  plan.
+
+(The reference-lineage note — how the C++ ``SHOW_TIME`` / ``FT_DEBUG``
+compile-time knobs map onto these runtime facilities — lives in
+``docs/OBSERVABILITY.md``.)
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import re
 import time
 
 from .logging import get_logger
@@ -38,6 +33,7 @@ __all__ = [
     "phase_timer",
     "PhaseTimer",
     "comm_span",
+    "span_bytes",
     "SpanLedger",
     "span_ledger",
     "exposed_split",
@@ -127,10 +123,22 @@ class SpanLedger:
         for name in self.spans:
             if not name.startswith(prefix):
                 continue
-            tail = name.rsplit("_", 1)[-1]
-            if tail.endswith("B") and tail[:-1].isdigit():
-                total += int(tail[:-1])
+            m = _BYTES_SUFFIX.search(name)
+            if m:
+                total += int(m.group(1))
         return total
+
+
+#: The byte-attribution suffix contract: the LAST ``_``-separated token
+#: must be exactly ``{digits}B``.  Anchored so a name whose final token
+#: merely *ends* in ``B`` (``..._fooB``, ``..._0xB``) never miscounts.
+_BYTES_SUFFIX = re.compile(r"_(\d+)B$")
+
+
+def span_bytes(name: str) -> int | None:
+    """The ``_{n}B`` payload suffix of a span name, or None."""
+    m = _BYTES_SUFFIX.search(name)
+    return int(m.group(1)) if m else None
 
 
 _ACTIVE_LEDGERS: list[SpanLedger] = []
@@ -166,7 +174,11 @@ def exposed_split(step_ms: float, nosync_step_ms: float, comm_total_ms: float):
 
 
 @contextlib.contextmanager
-def comm_span(name: str, timer: "PhaseTimer | None" = None):
+def comm_span(
+    name: str,
+    timer: "PhaseTimer | None" = None,
+    provenance: dict | None = None,
+):
     """Named communication span: a ``jax.named_scope`` (so the span shows up
     as a named range over its collectives in profiler traces, exactly like
     the per-stage ``ft_rs_stage*`` scopes) plus an optional host-side
@@ -180,11 +192,24 @@ def comm_span(name: str, timer: "PhaseTimer | None" = None):
     trace time, so the *timer* measures tracing, not execution — pass a
     timer only in eager/host-level phases; inside jitted code the named
     scope is the useful half.
+
+    Every span also feeds the active :class:`SpanLedger`\\ s and the
+    ambient flight recorder (:func:`flextree_tpu.obs.record_event`, a
+    no-op when none is installed): ``provenance`` — the comm plan behind
+    the span (``obs.provenance.bucket_provenance``) — upgrades the
+    recorded event from a bare ``collective`` to a ``bucket_planned``
+    carrying widths/codec/sharded and the predicted cost breakdown.
     """
     import jax
 
     for ledger in _ACTIVE_LEDGERS:
         ledger.record(name)
+    from ..obs import record_event
+
+    if provenance is not None:
+        record_event("bucket_planned", name=name, **provenance)
+    else:
+        record_event("collective", name=name, bytes=span_bytes(name))
     with jax.named_scope(name):
         yield
     if timer is not None:

@@ -76,6 +76,20 @@ class _ToyData:
         return tok, tok
 
 
+def _obs_ctx(rank: int = 0):
+    """Flight-recorder context for chaos children: armed by ``FT_OBS_DIR``
+    (tools/obs_chaos.py's dedicated scenario is the committed proof; this
+    knob lets ANY chaos run leave a mergeable forensic record)."""
+    import contextlib
+
+    obs_dir = os.environ.get("FT_OBS_DIR")
+    if not obs_dir:
+        return contextlib.nullcontext()
+    from flextree_tpu.obs import flight_recorder
+
+    return flight_recorder(obs_dir, rank=rank)
+
+
 def child_train() -> int:
     """The supervised training process (rank 0 of the heartbeat group)."""
     import numpy as np
@@ -143,15 +157,16 @@ def child_train() -> int:
         )
 
     state = {"step": np.int64(0), "w": np.zeros(4, dtype=np.float64)}
-    result = fit(
-        state, step_fn, _ToyData(),
-        FitConfig(
-            num_steps=steps, ckpt_dir=ckpt_dir,
-            ckpt_every=int(os.environ.get("FT_CKPT_EVERY", "5")),
-            log_every=0,
-        ),
-        supervision=supervision,
-    )
+    with _obs_ctx(rank=0):
+        result = fit(
+            state, step_fn, _ToyData(),
+            FitConfig(
+                num_steps=steps, ckpt_dir=ckpt_dir,
+                ckpt_every=int(os.environ.get("FT_CKPT_EVERY", "5")),
+                log_every=0,
+            ),
+            supervision=supervision,
+        )
     from flextree_tpu.utils.checkpoint import list_checkpoints
 
     payload = {
@@ -285,16 +300,17 @@ def child_train_sharded() -> int:
     state = init_train_state(
         jax.random.PRNGKey(0), model_cfg, base_tc, mesh=mesh
     )
-    result = fit(
-        state, step_fn, _LMData(),
-        FitConfig(
-            num_steps=steps, ckpt_dir=ckpt_dir,
-            ckpt_every=int(os.environ.get("FT_CKPT_EVERY", "4")),
-            log_every=10, prefetch=0,
-        ),
-        mesh=mesh, state_specs=packed_specs, supervision=supervision,
-        state_pack=pack, state_unpack=unpack,
-    )
+    with _obs_ctx(rank=0):
+        result = fit(
+            state, step_fn, _LMData(),
+            FitConfig(
+                num_steps=steps, ckpt_dir=ckpt_dir,
+                ckpt_every=int(os.environ.get("FT_CKPT_EVERY", "4")),
+                log_every=10, prefetch=0,
+            ),
+            mesh=mesh, state_specs=packed_specs, supervision=supervision,
+            state_pack=pack, state_unpack=unpack,
+        )
     # the consistency proof: consolidate the final sharded state, then
     # re-shard and re-consolidate — a consistent re-shard is a bitwise
     # fixed point, and every leaf must be finite

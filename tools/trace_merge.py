@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Merge per-rank flight-recorder files into one Perfetto-loadable trace.
+
+Thin wrapper over ``python -m flextree_tpu.obs merge`` so the workflow
+documented in docs/OBSERVABILITY.md works from a checkout without
+installing the package::
+
+    python tools/trace_merge.py RUN_OBS_DIR --out timeline.json
+
+Exit status is non-zero when there are no events to merge or the merged
+document fails the Chrome-trace schema check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flextree_tpu.obs.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(["merge", *sys.argv[1:]]))
