@@ -683,6 +683,59 @@ def run_serving_tripwire(timeout_s: int = 900) -> dict:
             pass
 
 
+def run_paged_tripwire(timeout_s: int = 900) -> dict:
+    """Supplementary keys ``paged_fused_decode_violations`` (fused paged
+    decode vs the gather oracle on this exact tree: per-round tolerance
+    misses + poisoned-null-block breaks + any preemption scenario that
+    lost, duplicated, or corrupted a request; 0 = clean) and
+    ``ondemand_admission_gain`` (mean concurrent resident sequences of
+    on-demand admission over reservation at equal pool memory — the
+    >= 1.3x floor and the >= 1.15x fused-round timing floor are enforced
+    in the full run committed as BENCH_PAGED.json; smoke reports them).
+    Runs ``tools/bench_paged.py --smoke`` in a subprocess (it pins its
+    own CPU backend) and reads the artifact.  Absent keys read as "not
+    verified", never as "clean"."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable, os.path.join(REPO, "tools", "bench_paged.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        floors = doc["floors"]
+        violations = (
+            floors["tolerance_violations"]
+            + floors["poison_violations"]
+            + int(not floors["preempt_swap_ok"])
+            + int(not floors["preempt_recompute_ok"])
+            + int(not floors["reserve_baseline_ok"])
+        )
+        out = {
+            "paged_fused_decode_violations": violations,
+            "ondemand_admission_gain": floors["ondemand_concurrency_gain"],
+            # informational in smoke: the enforced timing floor lives in
+            # the committed full-run BENCH_PAGED.json
+            "paged_fused_speedup": floors["fused_speedup"],
+        }
+        if p.returncode != 0:
+            out["paged_error"] = f"bench_paged rc={p.returncode}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"paged_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 _OBS_TRIPWIRE_CODE = r'''
 import json, os, sys, tempfile, time
 sys.path.insert(0, {repo!r})
@@ -833,6 +886,7 @@ def main() -> int:
         result.update(run_overlap_tripwire())
         result.update(run_sharded_tripwire())
         result.update(run_serving_tripwire())
+        result.update(run_paged_tripwire())
         result.update(run_obs_tripwire())
     print(json.dumps(result))
     return 0

@@ -1,5 +1,13 @@
 """Reduction ops: the dtype/op support matrix and kernels, plus the wire
-codecs for compressed collectives."""
+codecs for compressed collectives.
+
+The fused paged-decode attention lives in :mod:`.paged_attention` and is
+imported by its MODULE path (``from flextree_tpu.ops.paged_attention
+import paged_attention, paged_attention_gather, FUSED_DECODE_ATOL``) —
+the function shares the submodule's name, so a package-level re-export
+would be whichever of the two bound last (import-order dependent, the
+``os.path`` problem); the submodule path is the one canonical spelling.
+"""
 
 from .quantize import CODECS, Codec, decode_int8, encode_int8, get_codec
 from .reduce import ReduceOp, SUPPORTED_OPS, check_dtype, get_op

@@ -25,7 +25,13 @@ Measured artifact: ``tools/bench_serving.py`` → ``BENCH_SERVING.json``
 honest limits: ``docs/SERVING.md``.
 """
 
-from .batcher import BatcherConfig, ContinuousBatcher, Request, SeqState
+from .batcher import (
+    BatcherConfig,
+    ContinuousBatcher,
+    PreemptedSeq,
+    Request,
+    SeqState,
+)
 from .engine import CompletedRequest, ServingEngine
 from .kv_cache import (
     NULL_BLOCK,
@@ -37,6 +43,7 @@ from .kv_cache import (
     make_paged_decode_fn,
     paged_decode_step,
     write_prefill,
+    write_swapped,
 )
 from .pool import PoolConfig, ReplicaFailed, ReplicaPool
 
@@ -47,11 +54,13 @@ __all__ = [
     "PagedCacheConfig",
     "init_pools",
     "write_prefill",
+    "write_swapped",
     "paged_decode_step",
     "make_paged_decode_fn",
     "gather_seq",
     "Request",
     "SeqState",
+    "PreemptedSeq",
     "BatcherConfig",
     "ContinuousBatcher",
     "ServingEngine",

@@ -11,21 +11,44 @@ so every transition is unit-testable without a device.  State:
   decode always runs S rows, empty slots ride along as masked no-ops
   (their pool writes land in the null block).
 
-**Admission math** (``try_admit``): a request needs ``ceil((prompt_len +
-max_new_tokens) / block_size)`` cache blocks.  The batcher reserves ALL
-of them at admission — conservative (a request that stops early returns
-blocks it never wrote), but it makes mid-decode exhaustion structurally
-impossible: an admitted request always runs to retirement, so the engine
-never needs preemption/swap-out machinery.  A per-step **prefill token
-budget** caps how much prefill work joins one step, bounding the decode
-stall that admission imposes on already-running sequences
-(join-at-step: new requests prefill into free slots while running
-sequences keep decoding on the next step).
+**Admission math** (``try_admit``) comes in two modes
+(``BatcherConfig.admission``):
+
+- ``"reserve"`` (the conservative default): a request reserves ALL
+  ``ceil((prompt_len + max_new_tokens) / block_size)`` blocks at
+  admission — wasteful (a request that stops early returns blocks it
+  never wrote), but mid-decode exhaustion is structurally impossible and
+  an admitted request always runs to retirement.
+- ``"ondemand"`` (the vLLM-style allocator): admission reserves only the
+  PROMPT's blocks; decode blocks are allocated one at a time as each
+  sequence's length crosses a block boundary (``grow_for_decode``).  The
+  same pool now keeps more sequences resident — and pool exhaustion
+  mid-decode becomes structurally possible, which is what the
+  **preemption** machinery below exists for.
+
+**Preemption** (on-demand mode only): when ``grow_for_decode`` cannot
+allocate, the engine picks the NEWEST resident sequence
+(``pick_victim`` — newest-first minimizes wasted work and cannot starve
+the oldest), swaps its written K/V out to host memory (or drops it for
+prefill-replay recompute), and ``preempt`` frees its blocks and parks it
+on the ``preempted`` queue.  Preempted sequences resume with strict
+priority over fresh admissions (``try_resume`` runs first and
+``try_admit`` refuses to admit past a non-empty preempted queue — fresh
+short requests must never starve a half-done long one), and a resumed
+sequence continues bit-identically from its saved state.  Preempted
+sequences stay in ``inflight_requests()`` so the replica pool's drain
+re-queues them through the same exactly-once machinery as resident ones.
+
+A per-step **prefill token budget** caps how much prefill work joins one
+step, bounding the decode stall that admission imposes on already-running
+sequences (join-at-step: new requests prefill into free slots while
+running sequences keep decoding on the next step).
 
 **Retirement** (``retire_ready``): a sequence is done when it has emitted
 ``max_new_tokens`` tokens or a token in its ``stop_tokens``.  Retirement
-frees the slot and returns every reserved block to the allocator
-immediately — freed blocks admit queued requests on the very next step.
+frees the slot and returns every held block to the allocator
+immediately — freed blocks resume preempted sequences or admit queued
+requests on the very next step.
 """
 
 from __future__ import annotations
@@ -37,7 +60,13 @@ import numpy as np
 
 from .kv_cache import BlockAllocator, CacheExhausted, PagedCacheConfig, NULL_BLOCK
 
-__all__ = ["Request", "SeqState", "BatcherConfig", "ContinuousBatcher"]
+__all__ = [
+    "Request",
+    "SeqState",
+    "PreemptedSeq",
+    "BatcherConfig",
+    "ContinuousBatcher",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,10 +103,22 @@ class SeqState:
     admitted_s: float = 0.0
     first_token_s: float = 0.0
     done_s: float = 0.0
+    admit_seq: int = 0  # monotonic admission stamp: victim = largest
+    preempts: int = 0  # times this sequence was preempted
 
     @property
     def rid(self) -> int:
         return self.request.rid
+
+
+@dataclasses.dataclass
+class PreemptedSeq:
+    """A sequence evicted mid-decode: its full progress plus the swapped
+    K/V (host-side per-layer arrays for ``length`` positions), or ``kv =
+    None`` when the engine chose prefill-replay recompute."""
+
+    state: SeqState
+    kv: object = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,10 +127,26 @@ class BatcherConfig:
     ``max_prefill_tokens_per_step``: join-at-step budget — total prompt
     tokens admitted per engine step (at least one request is always
     admitted when a slot and blocks are free, so a long prompt cannot
-    deadlock itself)."""
+    deadlock itself).
+    ``admission``: ``"reserve"`` (whole budget up front, no preemption
+    possible — the conservative default) or ``"ondemand"`` (prompt
+    blocks only; decode grows per block boundary, exhaustion preempts).
+    ``preempt``: what the engine saves when it evicts — ``"swap"`` (K/V
+    bytes to host memory; resume is a scatter, bit-identical by
+    construction) or ``"recompute"`` (drop the K/V, replay
+    prompt+generated through prefill on resume — cheaper for short
+    contexts, pays forward FLOPs and a per-length compile)."""
 
     slots: int = 4
     max_prefill_tokens_per_step: int = 256
+    admission: str = "reserve"
+    preempt: str = "swap"
+
+    def __post_init__(self):
+        if self.admission not in ("reserve", "ondemand"):
+            raise ValueError(f"unknown admission mode {self.admission!r}")
+        if self.preempt not in ("swap", "recompute"):
+            raise ValueError(f"unknown preempt mode {self.preempt!r}")
 
 
 class ContinuousBatcher:
@@ -99,7 +156,14 @@ class ContinuousBatcher:
         self.allocator = BlockAllocator(pcfg.num_blocks)
         self.slots: list = [None] * bcfg.slots
         self.queue: deque = deque()
+        self.preempted: deque = deque()  # PreemptedSeq, resume-first FIFO
         self.rejected: list = []  # (rid, reason) for oversized requests
+        self.admit_blocked: tuple | None = None  # (rid, want, free) last round
+        self._admit_seq = 0  # monotonic stamp for newest-first victimhood
+
+    @property
+    def ondemand(self) -> bool:
+        return self.bcfg.admission == "ondemand"
 
     # ---- intake ------------------------------------------------------------
 
@@ -116,6 +180,16 @@ class ContinuousBatcher:
                  f"prompt+max_new {total} exceeds max_len {self.pcfg.max_len}")
             )
             return False
+        if self.pcfg.blocks_for(total) > self.pcfg.num_blocks - 1:
+            # the pool can NEVER hold it: under reservation it would wedge
+            # the queue head forever, under on-demand it would livelock the
+            # preemption loop (nothing else to evict frees enough)
+            self.rejected.append(
+                (request.rid,
+                 f"needs {self.pcfg.blocks_for(total)} blocks, pool holds "
+                 f"{self.pcfg.num_blocks - 1}")
+            )
+            return False
         if request.temperature > 0 and request.seed is None:
             # reject BEFORE admission: discovered mid-prefill this would
             # wedge the slot (blocks reserved, no sampler key)
@@ -129,15 +203,32 @@ class ContinuousBatcher:
     # ---- admission ---------------------------------------------------------
 
     def blocks_needed(self, request: Request) -> int:
+        """Blocks the request needs AT ADMISSION: the whole prompt+output
+        budget under reservation, the prompt only under on-demand."""
+        if self.ondemand:
+            return self.pcfg.blocks_for(request.prompt_len)
         return self.pcfg.blocks_for(
             request.prompt_len + request.max_new_tokens
         )
+
+    def _next_admit_seq(self) -> int:
+        self._admit_seq += 1
+        return self._admit_seq
 
     def try_admit(self, now_s: float = 0.0) -> list:
         """Admit queued requests into free slots under the block and
         prefill-token budgets.  Returns ``[(slot_idx, SeqState), ...]``
         for the engine to prefill; the states are already resident (the
-        reservation happened here — all-or-nothing per request)."""
+        allocation happened here — all-or-nothing per request).  Sets
+        ``admit_blocked`` when the queue head is blocked on BLOCKS (not
+        slots) — the engine's ``serve_admit_blocked`` signal."""
+        if self.preempted:
+            # resume-first, strictly: fresh admissions must not take the
+            # blocks a half-done preempted sequence is waiting for (and
+            # admit_blocked keeps whatever try_resume just recorded —
+            # clearing it here would wipe the resume-blocked signal)
+            return []
+        self.admit_blocked = None
         admitted = []
         budget = self.bcfg.max_prefill_tokens_per_step
         while self.queue:
@@ -149,8 +240,10 @@ class ContinuousBatcher:
                 break  # join-at-step budget spent; next step picks it up
             try:
                 blocks = self.allocator.alloc(self.blocks_needed(req))
-            except CacheExhausted:
-                break  # FIFO head-of-line: wait for retirements
+            except CacheExhausted as e:
+                # FIFO head-of-line: wait for retirements
+                self.admit_blocked = (req.rid, e.want, e.free)
+                break
             self.queue.popleft()
             budget -= req.prompt_len
             state = SeqState(
@@ -160,11 +253,93 @@ class ContinuousBatcher:
                 pending_token=-1,
                 generated=[],
                 admitted_s=now_s,
+                admit_seq=self._next_admit_seq(),
             )
             slot = free_slots[0]
             self.slots[slot] = state
             admitted.append((slot, state))
         return admitted
+
+    # ---- on-demand growth / preemption / resume ----------------------------
+
+    def blocks_for_resume(self, state: SeqState) -> int:
+        """Blocks a resumed sequence needs right now: its ``length``
+        written positions plus the current block its next decode write
+        lands in (``length // bs + 1`` covers both, mid-block or not)."""
+        return state.length // self.pcfg.block_size + 1
+
+    def try_resume(self, now_s: float = 0.0) -> list:
+        """Re-admit preempted sequences (FIFO, strict priority) into free
+        slots.  Returns ``[(slot_idx, SeqState, kv), ...]`` for the engine
+        to scatter (``kv`` is the swapped host K/V, or None for
+        prefill-replay recompute).  All-or-nothing per sequence.  The
+        resumed state KEEPS its original admission stamp: re-stamping
+        would make it the newest resident and therefore the very next
+        victim — a full swap-in immediately paid back out as a swap-out
+        with zero tokens decoded."""
+        self.admit_blocked = None
+        resumed = []
+        while self.preempted:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                break
+            pre = self.preempted[0]
+            try:
+                blocks = self.allocator.alloc(self.blocks_for_resume(pre.state))
+            except CacheExhausted as e:
+                self.admit_blocked = (pre.state.rid, e.want, e.free)
+                break
+            self.preempted.popleft()
+            pre.state.block_ids = blocks
+            slot = free_slots[0]
+            self.slots[slot] = pre.state
+            resumed.append((slot, pre.state, pre.kv))
+        return resumed
+
+    def grow_for_decode(self) -> list:
+        """On-demand only: allocate the block each active sequence's next
+        decode write needs, OLDEST first (so exhaustion lands on the
+        newest, which is also the preemption victim).  Returns the slots
+        that grew; raises :class:`CacheExhausted` when a needed block
+        cannot be allocated — the engine's preemption trigger."""
+        if not self.ondemand:
+            return []
+        grown = []
+        order = sorted(
+            self.active_slots(), key=lambda i: self.slots[i].admit_seq
+        )
+        for i in order:
+            s = self.slots[i]
+            need = s.length // self.pcfg.block_size + 1
+            while len(s.block_ids) < need:
+                s.block_ids.extend(self.allocator.alloc(1))
+                if i not in grown:
+                    grown.append(i)
+        return grown
+
+    def pick_victim(self) -> int | None:
+        """The preemption victim: the most recently ADMITTED resident
+        (largest admission stamp; a resumed sequence keeps its original
+        stamp, so it is never the immediate next victim of the swap-in
+        it just paid for).  None when fewer than two sequences are
+        resident — preempting the only one could never unblock anything."""
+        active = self.active_slots()
+        if len(active) < 2:
+            return None
+        return max(active, key=lambda i: self.slots[i].admit_seq)
+
+    def preempt(self, slot: int, kv=None) -> SeqState:
+        """Evict ``slot``: free every held block, park the sequence (and
+        the engine-saved ``kv``, if swapping) on the resume queue."""
+        s = self.slots[slot]
+        if s is None:
+            raise ValueError(f"slot {slot} holds no sequence")
+        self.allocator.free(s.block_ids)
+        s.block_ids = []
+        s.preempts += 1
+        self.slots[slot] = None
+        self.preempted.append(PreemptedSeq(state=s, kv=kv))
+        return s
 
     # ---- the decode-step view ---------------------------------------------
 
@@ -237,11 +412,18 @@ class ContinuousBatcher:
 
     @property
     def idle(self) -> bool:
-        return not self.queue and all(s is None for s in self.slots)
+        return (
+            not self.queue
+            and not self.preempted
+            and all(s is None for s in self.slots)
+        )
 
     def inflight_requests(self) -> list:
-        """Every submitted-but-unfinished request — queued or resident.
-        The replica pool drains this to re-route off a dead replica."""
+        """Every submitted-but-unfinished request — queued, resident, or
+        preempted.  The replica pool drains this to re-route off a dead
+        replica; a preempted sequence missing here would be the silently
+        lost request the exactly-once contract forbids."""
         out = [s.request for s in self.slots if s is not None]
+        out.extend(p.state.request for p in self.preempted)
         out.extend(self.queue)
         return out

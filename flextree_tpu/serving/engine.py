@@ -4,17 +4,29 @@ One :class:`ServingEngine` is one replica: it owns a paged K/V pool, a
 :class:`~flextree_tpu.serving.batcher.ContinuousBatcher`, and two jitted
 programs — prefill (one compile per distinct prompt length) and the paged
 decode step (ONE compile for the server lifetime; slot count, table
-width, and pool shape are all static).  ``step()`` is one scheduling
-round:
+width, and pool shape are all static).  The decode step runs **fused**
+paged attention by default (``fused=True`` → ``ops.paged_attention``
+streams K/V blocks through an online softmax, never materializing the
+gathered row; within a pinned tolerance of the gather oracle);
+``fused=False`` keeps the gather path, which is the one proven bitwise
+against ``generate``.  ``step()`` is one scheduling round:
 
-1. **admit** — pop queued requests into free slots under the block
-   reservation and prefill-token budgets; each admitted request runs
-   prefill, scatters its K/V into its reserved blocks, and emits its
-   first token (that's the TTFT moment — continuous batching's whole
-   advantage is that this happens while other sequences keep decoding);
-2. **decode** — one paged decode step over all S slots; active rows
+1. **resume** — preempted sequences re-enter free slots with strict
+   priority (swap-in scatter of their saved K/V, or prefill-replay
+   recompute), continuing bit-identically where they stopped;
+2. **admit** — pop queued requests into free slots under the block
+   (reservation or on-demand, per ``BatcherConfig.admission``) and
+   prefill-token budgets; each admitted request runs prefill, scatters
+   its K/V into its blocks, and emits its first token (that's the TTFT
+   moment — continuous batching's whole advantage is that this happens
+   while other sequences keep decoding);
+3. **grow** — on-demand admission allocates each active sequence's next
+   decode block as its length crosses a block boundary; pool exhaustion
+   preempts the newest resident sequence (swap-out/recompute) until the
+   survivors fit;
+4. **decode** — one paged decode step over all S slots; active rows
    advance one token, empty rows are masked no-ops;
-3. **retire** — finished sequences (stop token or ``max_new_tokens``)
+5. **retire** — finished sequences (stop token or ``max_new_tokens``)
    free their blocks immediately and land in ``completed``.
 
 Sampling is per request and host-side over the returned logits row:
@@ -34,6 +46,7 @@ import dataclasses
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..models.generate import prefill, sample_token
@@ -41,11 +54,18 @@ from ..models.transformer import TransformerConfig
 from ..obs import MetricsRegistry, record_event
 from .batcher import BatcherConfig, ContinuousBatcher, Request, SeqState
 from .kv_cache import (
+    CacheExhausted,
     PagedCacheConfig,
+    gather_seq,
     init_pools,
     make_paged_decode_fn,
     write_prefill,
+    write_swapped,
 )
+
+# cache-occupancy histogram buckets: fractions of the allocatable pool in
+# use, observed once per scheduling round (engine.report() embeds it)
+_OCCUPANCY_BUCKETS = tuple(round(0.1 * i, 1) for i in range(1, 11))
 
 __all__ = ["CompletedRequest", "ServingEngine"]
 
@@ -90,11 +110,15 @@ class ServingEngine:
         pcfg: PagedCacheConfig,
         bcfg: BatcherConfig | None = None,
         metrics: MetricsRegistry | None = None,
+        fused: bool = True,
+        decode_impl: str = "jnp",
     ):
         self.params = params
         self.cfg = cfg
         self.pcfg = pcfg
         self.bcfg = bcfg or BatcherConfig()
+        self.fused = bool(fused)
+        self.decode_impl = decode_impl
         # the engine's accounting lives in a metrics registry (shareable —
         # the replica pool passes one per replica so its report is a view
         # over the same counters); per-request timestamps stay on
@@ -106,11 +130,14 @@ class ServingEngine:
         # scatter aliases in place instead of copying the whole pool every
         # round (measured ~35% of the paged round's cost on the CPU
         # backend, which — on this pin — implements donation warning-free)
-        self._decode = make_paged_decode_fn(cfg, donate=True)
+        self._decode = make_paged_decode_fn(
+            cfg, donate=True, fused=self.fused, impl=decode_impl
+        )
         self._prefill = jax.jit(
             lambda p, tok: prefill(p, tok, cfg, max_len=pcfg.max_len)
         )
         self._write = jax.jit(write_prefill, donate_argnums=(0,))
+        self._write_back = jax.jit(write_swapped, donate_argnums=(0,))
         self._keys: dict = {}  # slot -> presplit (max_new, 2) key rows
         self.completed: dict = {}
         self.steps = 0
@@ -140,9 +167,20 @@ class ServingEngine:
     # ---- the scheduling round ----------------------------------------------
 
     def step(self) -> dict:
-        """One admit → decode → retire round; returns counters."""
+        """One resume → admit → grow → decode → retire round; returns
+        counters.  Growth (on-demand admission only) allocates each
+        active sequence's next decode block; exhaustion preempts the
+        newest resident sequence (swap-out or recompute per
+        ``BatcherConfig.preempt``) until the rest fit."""
         t0 = _now()
+        resumed = self.batcher.try_resume(t0)
+        for slot, state, kv in resumed:
+            self._resume_slot(slot, state, kv)
         admitted = self.batcher.try_admit(t0)
+        if self.batcher.admit_blocked is not None:
+            rid, want, free = self.batcher.admit_blocked
+            self.metrics.counter("serve.admit_blocked").inc()
+            record_event("serve_admit_blocked", rid=rid, want=want, free=free)
         for slot, state in admitted:
             record_event(
                 "serve_admit", rid=state.rid, slot=slot,
@@ -150,6 +188,7 @@ class ServingEngine:
                 blocks=len(state.block_ids),
             )
             self._prefill_slot(slot, state)
+        preempted = self._grow_with_preemption()
         active = self.batcher.active_slots()
         if active:
             tables, lengths, tokens, _ = self.batcher.batch_arrays()
@@ -174,10 +213,19 @@ class ServingEngine:
         m.counter("serve.admitted").inc(len(admitted))
         m.counter("serve.finished").inc(len(finished))
         m.gauge("serve.active_slots").set(self.batcher.num_active)
-        m.gauge("serve.free_blocks").set(self.batcher.allocator.num_free)
+        free = self.batcher.allocator.num_free
+        total = self.pcfg.num_blocks - 1
+        m.gauge("serve.free_blocks").set(free)
+        m.gauge("serve.active_blocks").set(total - free)
+        m.gauge("serve.preempted_seqs").set(len(self.batcher.preempted))
+        m.histogram(
+            "serve.cache_occupancy", buckets=_OCCUPANCY_BUCKETS
+        ).observe((total - free) / total)
         m.histogram("serve.round_ms").observe((_now() - t0) * 1e3)
         return {
             "admitted": len(admitted),
+            "resumed": len(resumed),
+            "preempted": preempted,
             "decoded": len(active),
             "finished": len(finished),
         }
@@ -190,6 +238,101 @@ class ServingEngine:
         raise RuntimeError(f"engine not idle after {max_steps} steps")
 
     # ---- internals ---------------------------------------------------------
+
+    def _grow_with_preemption(self) -> int:
+        """On-demand growth with the exhaustion → preempt loop: keep
+        evicting the newest resident sequence until every survivor's next
+        decode block allocates.  Returns how many sequences were
+        preempted this round; raises when a lone sequence cannot grow
+        (nothing left to evict — submit()'s pool-capacity guard makes
+        that unreachable for admissible requests)."""
+        preempted = 0
+        while True:
+            try:
+                self.batcher.grow_for_decode()
+                return preempted
+            except CacheExhausted:
+                victim = self.batcher.pick_victim()
+                if victim is None:
+                    raise
+                self._preempt_slot(victim)
+                preempted += 1
+
+    def _preempt_slot(self, slot: int) -> None:
+        state = self.batcher.slots[slot]
+        mode = self.bcfg.preempt
+        kv = None
+        if mode == "swap":
+            # host copies of the written positions — np.asarray moves the
+            # bytes off-device NOW, before the freed blocks are rewritten
+            view = gather_seq(self.pools, state.block_ids, length=state.length)
+            kv = {
+                "k": [np.asarray(k) for k in view["k"]],
+                "v": [np.asarray(v) for v in view["v"]],
+            }
+            swapped = sum(a.nbytes for a in kv["k"]) + sum(
+                a.nbytes for a in kv["v"]
+            )
+            self.metrics.counter("serve.swap_out_bytes").inc(swapped)
+            self.metrics.counter("serve.swap_outs").inc()
+            record_event(
+                "serve_swap_out", rid=state.rid, length=state.length,
+                bytes=swapped,
+            )
+        blocks = len(state.block_ids)
+        self.batcher.preempt(slot, kv)
+        self._keys.pop(slot, None)  # re-derived from the seed on resume
+        self.metrics.counter("serve.preempts").inc()
+        record_event(
+            "serve_preempt", rid=state.rid, slot=slot, mode=mode,
+            length=state.length, blocks_freed=blocks,
+            n_generated=len(state.generated),
+        )
+
+    def _resume_slot(self, slot: int, state: SeqState, kv) -> None:
+        req = state.request
+        n = len(state.block_ids)
+        bs = self.pcfg.block_size
+        if kv is not None:
+            # swap-in: scatter the exact saved bytes back (zero-padded to
+            # whole blocks; the pad sits past the causal bound, invisible
+            # until overwritten) — resume is bit-identical by construction
+            padded = {"k": [], "v": []}
+            for kind in ("k", "v"):
+                for a in kv[kind]:
+                    full = np.zeros((n * bs, *a.shape[1:]), a.dtype)
+                    full[: a.shape[0]] = a
+                    padded[kind].append(jnp.asarray(full))
+            self.pools = self._write_back(
+                self.pools, padded, np.asarray(state.block_ids, np.int32)
+            )
+        else:
+            # recompute: replay the tokens whose K/V were dropped (prompt
+            # + already-written decode tokens) through prefill
+            written = np.concatenate([
+                np.asarray(req.prompt, np.int32),
+                np.asarray(
+                    state.generated[: state.length - req.prompt_len],
+                    np.int32,
+                ),
+            ])
+            _, cache = self._prefill(self.params, written[None])
+            self.pools = self._write(
+                self.pools, cache, np.asarray(state.block_ids, np.int32)
+            )
+        if req.temperature > 0:
+            # same derivation as _prefill_slot: the schedule is a pure
+            # function of the seed, indexed by len(generated) — resume
+            # continues exactly where the evicted slot stopped
+            self._keys[slot] = jax.random.split(
+                jax.random.PRNGKey(req.seed), req.max_new_tokens
+            )
+        self.metrics.counter("serve.resumes").inc()
+        record_event(
+            "serve_resume", rid=state.rid, slot=slot,
+            mode="swap" if kv is not None else "recompute",
+            length=state.length, blocks=n,
+        )
 
     def _prefill_slot(self, slot: int, state: SeqState) -> None:
         req = state.request
@@ -266,7 +409,11 @@ class ServingEngine:
         and each distinct reservation size's pool write before a timed run
         (compiles otherwise land inside the first requests' latency).
         ``block_counts``: the distinct ``pcfg.blocks_for(prompt + max_new)``
-        values the workload will reserve."""
+        values the workload will reserve.  Under on-demand admission the
+        swap-in scatter is warmed for EVERY block count (a resume's count
+        is ``length//bs + 1`` at whatever length eviction struck — one
+        scatter compile per count, and an unwarmed one lands inside the
+        preemption stall it is supposed to be ending)."""
         S, P = self.bcfg.slots, self.pcfg.blocks_per_seq
         jax.block_until_ready(
             self._decode(
@@ -292,3 +439,35 @@ class ServingEngine:
                     np.arange(1, n + 1, dtype=np.int32),
                 )["k"][0]
             )
+        if self.batcher.ondemand:
+            # on-demand writes use block counts the caller's reservation
+            # math never names: admission scatters blocks_for(prompt)
+            # blocks and recompute-resume scatters length//bs + 1 — warm
+            # the prefill write AND the swap-in scatter for every count,
+            # or the compile lands inside the TTFT / preemption stall it
+            # was supposed to end
+            bs = self.pcfg.block_size
+            shape = (self.cfg.n_heads, self.cfg.head_dim)
+            if cache is None:
+                _, cache = self._prefill(
+                    self.params, np.zeros((1, 1), np.int32)
+                )
+            for n in range(1, P + 1):
+                jax.block_until_ready(
+                    self._write(
+                        init_pools(self.cfg, self.pcfg),
+                        cache,
+                        np.arange(1, n + 1, dtype=np.int32),
+                    )["k"][0]
+                )
+                zeros = [
+                    jnp.zeros((n * bs, *shape), self.cfg.dtype)
+                    for _ in range(self.cfg.n_layers)
+                ]
+                jax.block_until_ready(
+                    self._write_back(
+                        init_pools(self.cfg, self.pcfg),
+                        {"k": zeros, "v": zeros},
+                        np.arange(1, n + 1, dtype=np.int32),
+                    )["k"][0]
+                )

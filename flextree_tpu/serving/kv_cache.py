@@ -24,14 +24,27 @@ holds contributes exactly nothing, and the paged decode stays **bitwise
 identical** to the contiguous-cache decode (the property
 ``tools/bench_serving.py`` machine-checks).
 
-The decode step is gather → batched ragged decode → scatter: gather the
-table's blocks into a per-row contiguous (S, P*block_size, H, Dh) view,
-run exactly the ``models.generate`` math (shared helpers, not copies —
-the bitwise contract depends on one definition), and scatter the newly
-produced K/V back into each row's current block at ``length %
-block_size``.  All three phases live in one jitted function with the
-pool buffers donated, so steady-state decode is two compiled programs
-total (prefill + paged decode), same as the contiguous path.
+The decode step has two attention paths behind a ``fused=`` switch:
+
+- **gather** (``fused=False``) — gather the table's blocks into a
+  per-row contiguous (S, P*block_size, H, Dh) view, run exactly the
+  ``models.generate`` math (shared helpers, not copies — the bitwise
+  contract depends on one definition), and scatter the newly produced
+  K/V back into each row's current block.  This is the correctness
+  ORACLE: it is the path proven bitwise against ``generate``.
+- **fused** (``fused=True``) — ``ops.paged_attention`` walks the block
+  table with an online-softmax accumulator, reading K/V straight from
+  the pools and never materializing the (S, P*bs, H, Dh) view (the ~5 MB
+  of per-round copies the gather path pays at the bench config), and
+  stops at the batch's causal frontier instead of the full table width.
+  Identical masking, different floating-point summation order: gated
+  against the gather oracle within ``ops.paged_attention.
+  FUSED_DECODE_ATOL`` (tests + every ``tools/bench_paged.py`` rep), not
+  bitwise.
+
+Either way all phases live in one jitted function with the pool buffers
+donated, so steady-state decode is two compiled programs total (prefill
++ paged decode), same as the contiguous path.
 """
 
 from __future__ import annotations
@@ -43,7 +56,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..models.generate import _qkv, cached_attention
+from ..models.generate import _qkv
+from ..ops.paged_attention import paged_attention, paged_attention_gather
 from ..models.transformer import (
     TransformerConfig,
     apply_rope,
@@ -59,6 +73,7 @@ __all__ = [
     "BlockAllocator",
     "init_pools",
     "write_prefill",
+    "write_swapped",
     "paged_decode_step",
     "make_paged_decode_fn",
     "gather_seq",
@@ -186,8 +201,37 @@ def write_prefill(pools: dict, cache: dict, block_ids) -> dict:
     return {"k": out_k, "v": out_v}
 
 
+def write_swapped(pools: dict, kv: dict, block_ids) -> dict:
+    """Scatter a swapped-out sequence's saved K/V back into newly
+    assigned blocks — the resume half of preemption.
+
+    ``kv`` is per-layer ``{"k": [(n*bs, H, Dh)], "v": [...]}`` with
+    exactly ``len(block_ids) * block_size`` positions (the engine pads
+    the saved ``length`` positions with zeros host-side).  The pad
+    positions sit at or past the sequence's causal bound, so — the same
+    argument as ``write_prefill``'s over-scatter — they are invisible
+    until the decode writes overwrite them.  The restored bytes are the
+    exact bytes ``gather_seq`` saved, which is what makes swap-in resume
+    bit-identical.
+    """
+    idx = jnp.asarray(block_ids, jnp.int32)
+    n = idx.shape[0]
+    out_k, out_v = [], []
+    for pk, pv, k, v in zip(pools["k"], pools["v"], kv["k"], kv["v"]):
+        bs = pk.shape[1]
+        if k.shape[0] != n * bs:
+            raise ValueError(
+                f"swapped K/V holds {k.shape[0]} positions, "
+                f"{n} blocks need {n * bs}"
+            )
+        out_k.append(pk.at[idx].set(k.reshape(n, bs, *pk.shape[2:])))
+        out_v.append(pv.at[idx].set(v.reshape(n, bs, *pv.shape[2:])))
+    return {"k": out_k, "v": out_v}
+
+
 def paged_decode_step(params, pools, tables, lengths, tokens,
-                      cfg: TransformerConfig):
+                      cfg: TransformerConfig, fused: bool = False,
+                      impl: str = "jnp"):
     """One decode step for S slots over the paged pool.
 
     ``tables`` (S, P) int32 block tables, ``lengths`` (S,) int32 cache
@@ -203,10 +247,13 @@ def paged_decode_step(params, pools, tables, lengths, tokens,
     weights are exactly 0.0 — see the module docstring).
 
     The per-layer math calls the SAME helpers as the contiguous decode
-    (``_qkv`` / ``apply_rope`` / ``cached_attention`` / ``mlp_block`` /
-    ``final_logits``), and the gathered view has the same (S, P*bs) key
-    length the contiguous cache would — that, plus exact-zero masking, is
-    the whole bitwise-identity argument.
+    (``_qkv`` / ``apply_rope`` / ``mlp_block`` / ``final_logits``).
+    ``fused=False`` attends through ``ops.paged_attention_gather`` — the
+    gathered view has the same (S, P*bs) key length the contiguous cache
+    would, which plus exact-zero masking is the whole bitwise-identity
+    argument.  ``fused=True`` attends through ``ops.paged_attention``
+    (``impl=`` "jnp" block-streaming or "pallas"): same masking, online-
+    softmax summation order, within ``FUSED_DECODE_ATOL`` of the oracle.
     """
     s = tokens.shape[0]
     positions = lengths[:, None].astype(jnp.int32)  # (S, 1) per-sequence
@@ -214,9 +261,8 @@ def paged_decode_step(params, pools, tables, lengths, tokens,
     row = jnp.arange(s)
     blk = tables[row, lengths // bs]  # (S,) current block per slot
     off = lengths % bs
-    upd = jax.vmap(
-        lambda c, u, p: lax.dynamic_update_slice_in_dim(c, u, p, axis=0)
-    )
+    attend = paged_attention if fused else paged_attention_gather
+    kwargs = {"impl": impl} if fused else {}
     x = params["embed"][tokens[:, None]].astype(cfg.dtype)
     new_k, new_v = [], []
     for layer, pk, pv in zip(params["layers"], pools["k"], pools["v"]):
@@ -224,12 +270,9 @@ def paged_decode_step(params, pools, tables, lengths, tokens,
         q, k, v = _qkv(layer, h, cfg)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        # gather pages -> per-row contiguous (S, P*bs, H, Dh) view, with
-        # the new K/V spliced at each row's own length (the contiguous
-        # path's dynamic_update, vmapped over ragged offsets)
-        kc = upd(pk[tables].reshape(s, -1, *pk.shape[2:]), k, lengths)
-        vc = upd(pv[tables].reshape(s, -1, *pv.shape[2:]), v, lengths)
-        attn = cached_attention(q, kc, vc, positions)
+        attn = attend(
+            q[:, 0], k[:, 0], v[:, 0], pk, pv, tables, lengths, **kwargs
+        )[:, None]
         o = attn.reshape(s, 1, -1) @ layer["wo"].astype(cfg.dtype)
         x = x + o
         x = mlp_block(layer, x, cfg)
@@ -240,12 +283,14 @@ def paged_decode_step(params, pools, tables, lengths, tokens,
     return logits[:, 0], {"k": new_k, "v": new_v}
 
 
-def make_paged_decode_fn(cfg: TransformerConfig, donate: bool = True):
+def make_paged_decode_fn(cfg: TransformerConfig, donate: bool = True,
+                         fused: bool = False, impl: str = "jnp"):
     """Jit ``paged_decode_step`` with the pool buffers donated (the old
     pool is dead the moment the new one exists — donation keeps steady-
-    state decode allocation-free)."""
+    state decode allocation-free).  ``fused=``/``impl=`` select the
+    attention path (see :func:`paged_decode_step`)."""
     return jax.jit(
-        partial(paged_decode_step, cfg=cfg),
+        partial(paged_decode_step, cfg=cfg, fused=fused, impl=impl),
         donate_argnums=(1,) if donate else (),
     )
 

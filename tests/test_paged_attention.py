@@ -1,0 +1,251 @@
+"""Fused paged-attention decode vs the gather-materialize oracle.
+
+The contract (``ops/paged_attention.py``): the block-streaming paths —
+pure-JAX twin and Pallas kernel — attend over exactly the positions the
+gather path attends over (pool positions ``< length`` plus the new
+token at ``length``), differing only in floating-point summation order
+(online softmax folds block by block; the oracle reduces the whole
+gathered row at once).  So:
+
+- fused output == gather oracle within the pinned ``FUSED_DECODE_ATOL``,
+  across impls x chunk sizes x dtypes x ragged lengths (empty rows,
+  mid-block, block-aligned, full table);
+- the poisoned-null-block invariance — THE masking property the paged
+  cache leans on — holds **bitwise** on the fused paths: whatever a
+  masked position holds contributes exactly 0.0;
+- ``paged_decode_step(fused=True)`` tracks its gather twin within the
+  tolerance on logits while producing **bitwise-identical** pool
+  scatters (the scatter is shared code, only attention differs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flextree_tpu.models.transformer import TransformerConfig, init_params
+from flextree_tpu.ops.paged_attention import (
+    FUSED_DECODE_ATOL,
+    paged_attention,
+    paged_attention_gather,
+)
+from flextree_tpu.serving.kv_cache import (
+    NULL_BLOCK,
+    BlockAllocator,
+    PagedCacheConfig,
+    init_pools,
+    paged_decode_step,
+)
+
+S, H, D, N, BS, P = 5, 4, 16, 32, 8, 7
+#: ragged mix: empty row, short, block-aligned, mid-block, near-full
+LENGTHS = (0, 3, 8, 17, 41)
+
+
+def _inputs(dtype=jnp.float32, seed=0, lengths=LENGTHS):
+    rng = np.random.default_rng(seed)
+    q, kn, vn = (
+        jnp.asarray(rng.standard_normal((len(lengths), H, D)), dtype)
+        for _ in range(3)
+    )
+    kp, vp = (
+        jnp.asarray(rng.standard_normal((N, BS, H, D)), dtype)
+        for _ in range(2)
+    )
+    tables = np.zeros((len(lengths), P), np.int32)
+    free = list(range(1, N))
+    for s, L in enumerate(lengths):
+        n = int(L) // BS + 1  # blocks written + the one the write lands in
+        tables[s, :n] = [free.pop() for _ in range(n)]
+    return (q, kn, vn, kp, vp, jnp.asarray(tables),
+            jnp.asarray(lengths, jnp.int32))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "impl,kwargs",
+    [
+        ("jnp", {"block_chunk": 1}),
+        ("jnp", {"block_chunk": 2}),
+        ("jnp", {"block_chunk": 4}),
+        ("jnp", {"block_chunk": 64}),  # > P: clamped, single fold
+        ("pallas", {}),
+    ],
+)
+def test_fused_matches_gather_oracle(dtype, impl, kwargs):
+    args = _inputs(dtype)
+    ref = paged_attention_gather(*args).astype(jnp.float32)
+    out = paged_attention(*args, impl=impl, **kwargs).astype(jnp.float32)
+    tol = FUSED_DECODE_ATOL if dtype == jnp.float32 else 0.05
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=tol,
+                               rtol=0)
+
+
+def test_full_table_and_boundary_lengths():
+    """A maximally-full row (length == P*bs - 1, the largest value the
+    serving layer can reach — a row AT max_len has no room to decode),
+    and a length exactly at a block boundary — the off-by-one classes a
+    frontier bound can hide."""
+    lengths = (P * BS - 1, BS, 2 * BS)
+    rng = np.random.default_rng(1)
+    q, kn, vn = (
+        jnp.asarray(rng.standard_normal((3, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+    kp, vp = (
+        jnp.asarray(rng.standard_normal((N, BS, H, D)), jnp.float32)
+        for _ in range(2)
+    )
+    tables = np.zeros((3, P), np.int32)
+    free = list(range(1, N))
+    tables[0, :] = [free.pop() for _ in range(P)]  # full row
+    tables[1, :2] = [free.pop() for _ in range(2)]
+    tables[2, :3] = [free.pop() for _ in range(3)]
+    args = (q, kn, vn, kp, vp, jnp.asarray(tables),
+            jnp.asarray(lengths, jnp.int32))
+    ref = paged_attention_gather(*args)
+    for impl in ("jnp", "pallas"):
+        out = paged_attention(*args, impl=impl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=FUSED_DECODE_ATOL, rtol=0)
+
+
+@pytest.mark.parametrize("impl", ["jnp", "pallas"])
+def test_poisoned_null_block_invariance_bitwise(impl):
+    """The load-bearing masking property: null-block content (including
+    values big enough to overflow the score matmul) changes NOTHING —
+    bitwise — because masked probabilities are exactly 0.0 and 0.0 * x
+    never reaches the accumulator."""
+    q, kn, vn, kp, vp, tables, lengths = _inputs()
+    poisoned_k = kp.at[NULL_BLOCK].set(1e30)
+    poisoned_v = vp.at[NULL_BLOCK].set(1e30)
+    a = paged_attention(q, kn, vn, kp, vp, tables, lengths, impl=impl)
+    b = paged_attention(q, kn, vn, poisoned_k, poisoned_v, tables, lengths,
+                        impl=impl)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unwritten_tail_of_current_block_is_invisible():
+    """Positions >= length inside the partially-written current block are
+    masked too — poison them and the fused output must not move."""
+    q, kn, vn, kp, vp, tables, lengths = _inputs()
+    row = 3  # length 17: block 2 holds 16..23; 16 written, 17.. unwritten
+    blk = int(np.asarray(tables)[row, 2])
+    # poison from offset 1 = position 17, the FIRST masked position —
+    # the exact cell a `kpos <= length` off-by-one would expose
+    kp2 = kp.at[blk, 1:].set(1e30)
+    vp2 = vp.at[blk, 1:].set(1e30)
+    a = paged_attention(q, kn, vn, kp, vp, tables, lengths)
+    b = paged_attention(q, kn, vn, kp2, vp2, tables, lengths)
+    np.testing.assert_array_equal(np.asarray(a)[row], np.asarray(b)[row])
+
+
+def test_jnp_and_pallas_agree():
+    args = _inputs(seed=2)
+    a = paged_attention(*args, impl="jnp", block_chunk=1)
+    b = paged_attention(*args, impl="pallas")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=FUSED_DECODE_ATOL, rtol=0)
+
+
+def test_shape_validation_is_loud():
+    q, kn, vn, kp, vp, tables, lengths = _inputs()
+    with pytest.raises(ValueError, match="queries"):
+        paged_attention(q[0], kn, vn, kp, vp, tables, lengths)
+    with pytest.raises(ValueError, match="new-token"):
+        paged_attention(q, kn[:, :2], vn, kp, vp, tables, lengths)
+    with pytest.raises(ValueError, match="lengths"):
+        paged_attention(q, kn, vn, kp, vp, tables, lengths[:-1])
+    with pytest.raises(ValueError, match="impl"):
+        paged_attention(q, kn, vn, kp, vp, tables, lengths, impl="cuda")
+
+
+# ---------------------------------------------------- whole-decode-step level
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _decode_state(cfg, pcfg, lengths, seed=4):
+    rng = np.random.default_rng(seed)
+    pools = init_pools(cfg, pcfg)
+    pools = {
+        kind: [
+            jnp.asarray(
+                rng.standard_normal(p.shape).astype(np.float32), cfg.dtype
+            )
+            for p in pools[kind]
+        ]
+        for kind in ("k", "v")
+    }
+    alloc = BlockAllocator(pcfg.num_blocks)
+    tables = np.zeros((len(lengths), pcfg.blocks_per_seq), np.int32)
+    for s, L in enumerate(lengths):
+        n = int(L) // pcfg.block_size + 1
+        tables[s, :n] = alloc.alloc(n)
+    tokens = rng.integers(0, cfg.vocab_size, (len(lengths),)).astype(np.int32)
+    return pools, jnp.asarray(tables), jnp.asarray(lengths, jnp.int32), tokens
+
+
+def test_decode_step_fused_vs_gather(model):
+    """Logits within tolerance; layer 0's pool scatter is BITWISE (its
+    K/V depend only on the embedding, before any attention differs) and
+    deeper layers' scatters inherit the attention tolerance through the
+    residual stream."""
+    cfg, params = model
+    pcfg = PagedCacheConfig(num_blocks=24, block_size=8, blocks_per_seq=6)
+    pools, tables, lengths, tokens = _decode_state(
+        cfg, pcfg, (5, 12, 24, 33)
+    )
+    ref_logits, ref_pools = paged_decode_step(
+        params, pools, tables, lengths, tokens, cfg, fused=False
+    )
+    for impl in ("jnp", "pallas"):
+        logits, out_pools = paged_decode_step(
+            params, pools, tables, lengths, tokens, cfg, fused=True, impl=impl
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref_logits),
+            atol=FUSED_DECODE_ATOL * 10, rtol=0,
+        )  # logits pass through 2 more matmul layers than the attention out
+        np.testing.assert_array_equal(
+            np.asarray(out_pools["k"][0]), np.asarray(ref_pools["k"][0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_pools["v"][0]), np.asarray(ref_pools["v"][0])
+        )
+        for l in range(1, cfg.n_layers):
+            np.testing.assert_allclose(
+                np.asarray(out_pools["k"][l]), np.asarray(ref_pools["k"][l]),
+                atol=FUSED_DECODE_ATOL, rtol=0,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_pools["v"][l]), np.asarray(ref_pools["v"][l]),
+                atol=FUSED_DECODE_ATOL, rtol=0,
+            )
+
+
+def test_decode_step_fused_greedy_tokens_match_oracle(model):
+    """The serving-level consequence: greedy argmax over fused logits
+    equals the gather oracle's on this workload (the bench re-checks this
+    on every rep of the real load run)."""
+    cfg, params = model
+    pcfg = PagedCacheConfig(num_blocks=24, block_size=8, blocks_per_seq=6)
+    pools, tables, lengths, tokens = _decode_state(
+        cfg, pcfg, (3, 9, 20, 40), seed=5
+    )
+    ref_logits, _ = paged_decode_step(
+        params, pools, tables, lengths, tokens, cfg, fused=False
+    )
+    logits, _ = paged_decode_step(
+        params, pools, tables, lengths, tokens, cfg, fused=True
+    )
+    np.testing.assert_array_equal(
+        np.argmax(np.asarray(logits), axis=-1),
+        np.argmax(np.asarray(ref_logits), axis=-1),
+    )

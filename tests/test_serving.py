@@ -15,7 +15,13 @@ The decisive properties, in dependency order:
 - **elastic pool**: a dead replica (hang, crash, or silent heartbeat
   death — the latter driven by the injectable ``_wall`` clock) drains its
   in-flight requests to survivors and the pool finishes everything,
-  degraded instead of failed.
+  degraded instead of failed;
+- **on-demand admission + preemption** (PR 11): prompt-blocks-only
+  admission grows per block boundary, keeps more sequences resident than
+  reservation at equal pool memory, and mid-decode exhaustion preempts
+  the newest sequence (swap-out or recompute) with resume that continues
+  to exactly ``generate``'s tokens — including a resume that lands
+  mid-block, and through the replica pool's drain/re-route.
 """
 
 import jax
@@ -103,6 +109,53 @@ def test_allocator_never_hands_out_null_block():
         BlockAllocator(num_blocks=1)
     with pytest.raises(ValueError):
         a.free([NULL_BLOCK])
+
+
+def test_allocator_churn_property():
+    """Random alloc/free interleavings (the on-demand allocator's real
+    life): the null block is never handed out, no block is ever owned
+    twice, and the free list never acquires duplicates or foreign ids —
+    across 200 seeded episodes of mixed traffic."""
+    rng = np.random.default_rng(42)
+    a = BlockAllocator(num_blocks=17)  # 16 allocatable
+    held: list = []  # lists of blocks, freed in random order/groups
+    for step in range(200):
+        # invariants, every step
+        free = set(a._free)
+        owned = set(a._allocated)
+        assert NULL_BLOCK not in free and NULL_BLOCK not in owned
+        assert len(a._free) == len(free), "free list acquired duplicates"
+        assert not (free & owned), "a block is both free and allocated"
+        assert free | owned == set(range(1, 17)), "foreign or lost ids"
+        if held and (rng.random() < 0.45 or a.num_free == 0):
+            grp = held.pop(rng.integers(len(held)))
+            # split the group: partial frees interleave with allocs
+            cut = int(rng.integers(len(grp) + 1))
+            if cut:
+                a.free(grp[:cut])
+            if grp[cut:]:
+                held.append(grp[cut:])
+        else:
+            want = int(rng.integers(1, 5))
+            if want > a.num_free:
+                with pytest.raises(CacheExhausted):
+                    a.alloc(want)
+            else:
+                got = a.alloc(want)
+                assert len(set(got)) == len(got), "double-allocated"
+                assert NULL_BLOCK not in got
+                held.append(got)
+    for grp in held:
+        a.free(grp)
+    assert a.num_free == 16
+
+
+def test_allocator_free_rejects_foreign_ids():
+    a = BlockAllocator(num_blocks=6)
+    got = a.alloc(2)
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free(got + [99])  # foreign id: loud, and the call takes nothing
+    assert a.num_free == 3
 
 
 def test_paged_cache_config_validation():
@@ -359,6 +412,279 @@ def test_batch_arrays_masks_inactive_slots():
     assert b.slots[slot].done and b.slots[slot].done_s == 4.0
 
 
+# -------------------------------------------- on-demand admission/preemption
+
+
+def test_ondemand_admits_on_prompt_blocks_only():
+    pcfg = _pcfg(num_blocks=8)  # 7 allocatable
+    b = ContinuousBatcher(
+        pcfg, BatcherConfig(slots=4, admission="ondemand")
+    )
+    # reservation would need ceil((9+30)/8) = 5 blocks each: one admits.
+    # on-demand needs ceil(9/8) = 2: three admit concurrently.
+    for i in range(3):
+        assert b.submit(Request(rid=i, prompt=np.zeros(9, np.int32),
+                                max_new_tokens=30))
+    admitted = b.try_admit()
+    assert [s.rid for _, s in admitted] == [0, 1, 2]
+    assert b.allocator.num_free == 1  # 3 x 2 prompt blocks
+    # the same traffic under reservation: head-of-line blocks after one
+    br = ContinuousBatcher(pcfg, BatcherConfig(slots=4, admission="reserve"))
+    for i in range(3):
+        br.submit(Request(rid=i, prompt=np.zeros(9, np.int32),
+                          max_new_tokens=30))
+    assert [s.rid for _, s in br.try_admit()] == [0]
+    assert br.admit_blocked is not None  # rid 1 blocked on blocks
+
+
+def test_ondemand_grow_allocates_at_block_boundary():
+    pcfg = _pcfg(num_blocks=16)
+    b = ContinuousBatcher(pcfg, BatcherConfig(slots=2, admission="ondemand"))
+    b.submit(Request(rid=0, prompt=np.zeros(8, np.int32), max_new_tokens=12))
+    [(slot, s)] = b.try_admit()
+    assert len(s.block_ids) == 1  # exactly the prompt's block
+    b.record_first_token(slot, 1, now_s=0.0)
+    # length 8 = block boundary: the first decode write needs block 2
+    assert b.grow_for_decode() == [slot]
+    assert len(s.block_ids) == 2
+    # mid-block positions need nothing
+    b.record_decode_token(slot, 2, now_s=0.0)  # length 9
+    assert b.grow_for_decode() == []
+    for _ in range(7):
+        b.record_decode_token(slot, 2, now_s=0.0)  # length 16: boundary
+    assert b.grow_for_decode() == [slot]
+    assert len(s.block_ids) == 3
+
+
+def test_pick_victim_is_newest_and_never_the_last():
+    pcfg = _pcfg(num_blocks=32)
+    b = ContinuousBatcher(pcfg, BatcherConfig(slots=3, admission="ondemand"))
+    for i in range(2):
+        b.submit(Request(rid=i, prompt=np.zeros(4, np.int32),
+                         max_new_tokens=4))
+    (s0, st0), (s1, st1) = b.try_admit()
+    assert st1.admit_seq > st0.admit_seq
+    assert b.pick_victim() == s1  # newest
+    b.record_first_token(s0, 1, 0.0)
+    b.record_first_token(s1, 1, 0.0)
+    kv = None
+    b.preempt(s1, kv)
+    assert b.pick_victim() is None  # one resident: nothing to evict
+    assert [p.state.rid for p in b.preempted] == [1]
+    assert st1.block_ids == [] and st1.preempts == 1
+
+
+def test_preempted_resume_has_priority_over_fresh_admissions():
+    pcfg = _pcfg(num_blocks=32)
+    b = ContinuousBatcher(pcfg, BatcherConfig(slots=2, admission="ondemand"))
+    b.submit(Request(rid=0, prompt=np.zeros(4, np.int32), max_new_tokens=8))
+    [(slot, st)] = b.try_admit()
+    b.record_first_token(slot, 1, 0.0)
+    b.preempt(slot, None)
+    b.submit(Request(rid=1, prompt=np.zeros(4, np.int32), max_new_tokens=8))
+    # fresh admission must refuse while a preempted sequence waits
+    assert b.try_admit() == []
+    [(rslot, rstate, kv)] = b.try_resume()
+    assert rstate.rid == 0 and kv is None
+    assert len(rstate.block_ids) == rstate.length // pcfg.block_size + 1
+    # with the resume done, the fresh request admits
+    assert [s.rid for _, s in b.try_admit()] == [1]
+
+
+def test_submit_rejects_requests_the_pool_can_never_hold():
+    pcfg = _pcfg(num_blocks=4)  # 3 allocatable, max_len still 48
+    for mode in ("reserve", "ondemand"):
+        b = ContinuousBatcher(pcfg, BatcherConfig(slots=2, admission=mode))
+        assert not b.submit(
+            Request(rid=0, prompt=np.zeros(20, np.int32), max_new_tokens=20)
+        )  # needs 5 blocks, pool holds 3: wedge (reserve) or livelock (ondemand)
+        assert "pool holds" in b.rejected[-1][1]
+
+
+@pytest.mark.parametrize("preempt", ["swap", "recompute"])
+def test_engine_preemption_resume_matches_generate(model, preempt):
+    """Injected exhaustion: a pool too small for the traffic preempts
+    mid-decode; every sequence still finishes with exactly generate()'s
+    tokens (swap-in restores the exact K/V bytes; recompute replays
+    prefill), blocks all return, and the preempt/resume accounting shows
+    the machinery actually fired."""
+    cfg, params = model
+    pcfg = _pcfg(num_blocks=10)  # 9 allocatable blocks
+    eng = ServingEngine(
+        params, cfg, pcfg,
+        BatcherConfig(slots=4, admission="ondemand", preempt=preempt),
+    )
+    rng = np.random.default_rng(11)
+    # prompts of 9 -> length hits boundaries mid-run; 4 resident sequences
+    # want up to 4 x ceil((9+20)/8) = 16 blocks against 9: must preempt
+    reqs = [Request(rid=i, prompt=_prompt(rng, 9), max_new_tokens=20)
+            for i in range(5)]
+    for r in reqs:
+        assert eng.submit(r)
+    eng.run_until_idle()
+    snap = eng.metrics.snapshot()["counters"]
+    assert snap.get("serve.preempts", 0) >= 1
+    assert snap.get("serve.resumes", 0) == snap.get("serve.preempts")
+    if preempt == "swap":
+        assert snap.get("serve.swap_outs", 0) >= 1
+    assert sorted(eng.completed) == list(range(5))
+    for r in reqs:
+        want = np.asarray(
+            generate(params, jnp.asarray(r.prompt)[None], cfg,
+                     max_new_tokens=20, max_len=pcfg.max_len)
+        )[0]
+        np.testing.assert_array_equal(eng.completed[r.rid].tokens, want)
+    assert eng.batcher.allocator.num_free == 9
+
+
+def test_engine_midblock_swap_resume_is_bit_identical(model):
+    """Force a victim whose length is NOT a block multiple, resume it,
+    and check its restored K/V bytes equal the swapped bytes exactly —
+    the bit-identical-resume contract at the pool level."""
+    cfg, params = model
+    pcfg = _pcfg(num_blocks=12)
+    eng = ServingEngine(
+        params, cfg, pcfg,
+        BatcherConfig(slots=2, admission="ondemand", preempt="swap"),
+    )
+    rng = np.random.default_rng(12)
+    req = Request(rid=0, prompt=_prompt(rng, 9), max_new_tokens=8)
+    eng.submit(req)
+    eng.step()  # prefill + first decode: length 9, mid-block
+    state = eng.batcher.slots[0]
+    assert state.length % pcfg.block_size != 0
+    saved = gather_seq(eng.pools, state.block_ids, length=state.length)
+    saved = {k: [np.asarray(x) for x in v] for k, v in saved.items()}
+    eng._preempt_slot(0)
+    assert eng.batcher.preempted and state.block_ids == []
+    [(slot, rstate, kv)] = eng.batcher.try_resume()
+    eng._resume_slot(slot, rstate, kv)
+    restored = gather_seq(eng.pools, rstate.block_ids, length=rstate.length)
+    for l in range(cfg.n_layers):
+        np.testing.assert_array_equal(
+            np.asarray(restored["k"][l]), saved["k"][l]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored["v"][l]), saved["v"][l]
+        )
+    eng.run_until_idle()
+    want = np.asarray(
+        generate(params, jnp.asarray(req.prompt)[None], cfg,
+                 max_new_tokens=8, max_len=pcfg.max_len)
+    )[0]
+    np.testing.assert_array_equal(eng.completed[0].tokens, want)
+
+
+def test_engine_sampled_request_survives_preemption(model):
+    """The per-request key schedule is a pure function of the seed:
+    eviction and resume must not shift it."""
+    cfg, params = model
+    pcfg = _pcfg(num_blocks=9)  # 8 allocatable: 3 residents x 3 blocks > 8
+    eng = ServingEngine(
+        params, cfg, pcfg,
+        BatcherConfig(slots=3, admission="ondemand", preempt="swap"),
+    )
+    rng = np.random.default_rng(13)
+    reqs = [
+        Request(rid=i, prompt=_prompt(rng, 9), max_new_tokens=16,
+                temperature=0.7, top_k=8, seed=100 + i)
+        for i in range(4)
+    ]
+    for r in reqs:
+        assert eng.submit(r)
+    eng.run_until_idle()
+    assert eng.metrics.counter("serve.preempts").value >= 1
+    for r in reqs:
+        want = np.asarray(
+            generate(params, jnp.asarray(r.prompt)[None], cfg,
+                     max_new_tokens=16, max_len=pcfg.max_len,
+                     temperature=0.7, top_k=8,
+                     key=jax.random.PRNGKey(r.seed))
+        )[0]
+        np.testing.assert_array_equal(eng.completed[r.rid].tokens, want)
+
+
+def test_engine_gather_path_still_bitwise(model):
+    """The oracle must stay covered now that fused is the default: an
+    explicit fused=False engine reproduces generate() bitwise."""
+    cfg, params = model
+    pcfg = _pcfg()
+    eng = ServingEngine(params, cfg, pcfg, BatcherConfig(slots=2),
+                        fused=False)
+    rng = np.random.default_rng(14)
+    reqs = [Request(rid=i, prompt=_prompt(rng, t), max_new_tokens=6)
+            for i, t in enumerate([5, 11])]
+    for r in reqs:
+        eng.submit(r)
+    eng.run_until_idle()
+    for r in reqs:
+        want = np.asarray(
+            generate(params, jnp.asarray(r.prompt)[None], cfg,
+                     max_new_tokens=6, max_len=pcfg.max_len)
+        )[0]
+        np.testing.assert_array_equal(eng.completed[r.rid].tokens, want)
+
+
+def test_engine_report_carries_cache_pressure_metrics(model):
+    cfg, params = model
+    eng = ServingEngine(
+        params, cfg, _pcfg(num_blocks=10),
+        BatcherConfig(slots=4, admission="ondemand"),
+    )
+    rng = np.random.default_rng(15)
+    for i in range(5):
+        eng.submit(Request(rid=i, prompt=_prompt(rng, 9), max_new_tokens=20))
+    eng.run_until_idle()
+    rep = eng.report()
+    assert "serve.free_blocks" in rep["gauges"]
+    assert "serve.active_blocks" in rep["gauges"]
+    assert rep["gauges"]["serve.active_blocks"] == 0  # all retired
+    occ = rep["histograms"]["serve.cache_occupancy"]
+    assert occ["count"] == eng.steps and 0.0 < occ["max"] <= 1.0
+    assert rep["counters"]["serve.preempts"] >= 1
+
+
+def test_pool_drain_reroutes_preempted_sequences(model, tmp_path):
+    """A replica dying WITH a parked preempted sequence must re-route it
+    like any other in-flight request — the exactly-once machinery covers
+    the preempted queue too."""
+    cfg, params = model
+    pcfg = _pcfg(num_blocks=10)
+    engines = [
+        ServingEngine(params, cfg, pcfg,
+                      BatcherConfig(slots=3, admission="ondemand"))
+        for _ in range(2)
+    ]
+    pool = ReplicaPool(
+        engines,
+        PoolConfig(heartbeat_dir=str(tmp_path / "hb"), step_timeout_s=120.0,
+                   lease_s=30.0, max_suspect_strikes=2),
+    )
+    rng = np.random.default_rng(16)
+    reqs = [Request(rid=200 + i, prompt=_prompt(rng, 9), max_new_tokens=20)
+            for i in range(6)]
+    for r in reqs:
+        pool.submit(r)
+    # run until replica 1 has actually preempted something, then kill it
+    for _ in range(40):
+        pool.step()
+        if engines[1].batcher.preempted:
+            break
+    assert engines[1].batcher.preempted, "scenario did not reach preemption"
+    parked = [p.state.rid for p in engines[1].batcher.preempted]
+    pool.kill(1, mode="raise")
+    rep = pool.run_until_idle()
+    assert rep["completed"] == 6 and rep["degraded"]
+    for r in reqs:
+        want = np.asarray(
+            generate(params, jnp.asarray(r.prompt)[None], cfg,
+                     max_new_tokens=20, max_len=pcfg.max_len)
+        )[0]
+        np.testing.assert_array_equal(pool.completed[r.rid].tokens, want)
+    assert all(rid in pool.completed for rid in parked)
+    pool.shutdown()
+
+
 # ----------------------------------------------------------- elastic pool
 
 
@@ -369,7 +695,12 @@ def _mk_pool(model, tmp_path, n=2, **cfg_kw):
         ServingEngine(params, cfg, pcfg, BatcherConfig(slots=2))
         for _ in range(n)
     ]
-    kw = dict(heartbeat_dir=str(tmp_path / "hb"), step_timeout_s=5.0,
+    # the default watchdog deadline is deliberately generous: pool tests
+    # step UNWARMED engines, and a prefill/decode compile landing inside
+    # a tight deadline on a loaded host strikes out a healthy replica (a
+    # flake observed at 5 s).  Tests of the hang path pass their own
+    # step_timeout_s and warm their engines first.
+    kw = dict(heartbeat_dir=str(tmp_path / "hb"), step_timeout_s=120.0,
               lease_s=30.0, max_suspect_strikes=2)
     kw.update(cfg_kw)
     return ReplicaPool(engines, PoolConfig(**kw)), pcfg

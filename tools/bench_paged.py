@@ -1,0 +1,455 @@
+#!/usr/bin/env python
+"""Fused paged decode + on-demand admission A/B — the PR 11 evidence.
+
+Produces ``BENCH_PAGED.json``, machine-checked with a non-zero exit on
+any violation:
+
+1. **Fused-round floor**: the fused paged decode round (block-streaming
+   ``ops.paged_attention``) runs >= 1.15x the gather-materialize round
+   over the REAL round states of the serving workload — the bench
+   replays every (tables, lengths, tokens) decode state an actual
+   engine run produced, so the ratio is weighted exactly like the
+   traffic that pays it.  Timing floors are enforced on the full run
+   only (CI smoke reports them); correctness floors always are.
+2. **Tolerance floor**: on every replayed round, fused logits match the
+   gather oracle within the pinned tolerance, and the poisoned-null-block
+   invariance holds bitwise on the fused path (active rows).
+3. **On-demand concurrency floor**: at EQUAL pool memory, on-demand
+   admission sustains >= 1.3x the mean concurrent resident sequences of
+   reservation admission (peak ratio reported too), on a workload sized
+   so the pool — not the slot count — is the binding constraint for
+   reservation.
+4. **Preemption floor**: the on-demand run's pool is deliberately too
+   small for its traffic (injected exhaustion): at least one preemption
+   must fire, every submitted request must finish exactly once, and
+   every output must equal the contiguous-cache ``generate`` bitwise —
+   for BOTH preempt modes (swap and recompute).
+
+Usage: python tools/bench_paged.py [--smoke] [--out BENCH_PAGED.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import json
+import os
+import platform
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from flextree_tpu.models.generate import generate  # noqa: E402
+from flextree_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    init_params,
+)
+from flextree_tpu.ops.paged_attention import FUSED_DECODE_ATOL  # noqa: E402
+from flextree_tpu.serving import (  # noqa: E402
+    NULL_BLOCK,
+    BatcherConfig,
+    PagedCacheConfig,
+    Request,
+    ServingEngine,
+)
+from flextree_tpu.serving.kv_cache import (  # noqa: E402
+    init_pools,
+    paged_decode_step,
+)
+
+MIN_FUSED_SPEEDUP = 1.15  # acceptance floor: gather round / fused round
+MIN_CONCURRENCY_GAIN = 1.3  # on-demand vs reservation mean residency
+LOGITS_ATOL = FUSED_DECODE_ATOL * 10  # logits sit 2 matmuls past attention
+PROMPT_LENS = (4, 8, 12, 16)
+OUT_LENS = (4, 8, 16, 64)
+OUT_PROBS = (0.35, 0.25, 0.25, 0.15)
+SLOTS = 8
+
+_now = time.monotonic
+
+
+def _model(seed: int = 0):
+    # the bench_serving model: big enough that a decode round's compute
+    # dominates the host loop, small enough for CI minutes
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=256, n_heads=8, n_layers=4, d_ff=1024
+    )
+    return cfg, init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _pcfg() -> PagedCacheConfig:
+    # the committed serving config: 80 allocatable blocks, max_len 80
+    return PagedCacheConfig(num_blocks=81, block_size=8, blocks_per_seq=10)
+
+
+def build_workload(seed: int, n: int) -> list:
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        t = int(rng.choice(PROMPT_LENS))
+        m = int(rng.choice(OUT_LENS, p=OUT_PROBS))
+        out.append(Request(
+            rid=i,
+            prompt=rng.integers(0, 256, (t,)).astype(np.int32),
+            max_new_tokens=m,
+        ))
+    return out
+
+
+# ----------------------------------------------- fused vs gather round replay
+
+
+def capture_round_states(cfg, params, pcfg, requests) -> list:
+    """Run the workload through a gather-oracle engine and record every
+    decode round's (tables, lengths, tokens) — the EXACT states whose
+    cost the fused path claims to improve."""
+    states = []
+    eng = ServingEngine(params, cfg, pcfg, BatcherConfig(slots=SLOTS),
+                        fused=False)
+    orig = eng._decode
+
+    def recording(params_, pools_, tables, lengths, tokens):
+        states.append((tables.copy(), lengths.copy(), tokens.copy()))
+        return orig(params_, pools_, tables, lengths, tokens)
+
+    eng.warmup(
+        sorted({r.prompt_len for r in requests}),
+        {pcfg.blocks_for(r.prompt_len + r.max_new_tokens) for r in requests},
+    )
+    eng._decode = recording  # after warmup: only real rounds are captured
+    for r in requests:
+        assert eng.submit(r)
+    eng.run_until_idle()
+    return states
+
+
+def _rand_pools(cfg, pcfg, seed=0):
+    rng = np.random.default_rng(seed)
+    pools = init_pools(cfg, pcfg)
+    return {
+        kind: [
+            jnp.asarray(
+                rng.standard_normal(p.shape).astype(np.float32), cfg.dtype
+            )
+            for p in pools[kind]
+        ]
+        for kind in ("k", "v")
+    }
+
+
+def run_round_replay(cfg, params, pcfg, states, reps: int) -> dict:
+    """Time both decode paths over every captured round state,
+    interleaved (gather, fused) per rep with best-of aggregation, and
+    check the fused logits against the gather oracle on every state."""
+    gather_fn = jax.jit(
+        functools.partial(paged_decode_step, cfg=cfg, fused=False),
+        donate_argnums=(1,),
+    )
+    fused_fn = jax.jit(
+        functools.partial(paged_decode_step, cfg=cfg, fused=True),
+        donate_argnums=(1,),
+    )
+
+    # correctness sweep (un-donated pools, shared state): tolerance on
+    # every captured round + poisoned-null-block invariance on the fused
+    # path.  A rep that violates cannot hide behind a faster twin.
+    pools = _rand_pools(cfg, pcfg)
+    tol_violations = 0
+    poison_violations = 0
+    max_abs_diff = 0.0
+    poisoned = {
+        kind: [p.at[NULL_BLOCK].set(1e30) for p in pools[kind]]
+        for kind in ("k", "v")
+    }
+    for tables, lengths, tokens in states:
+        ref, _ = paged_decode_step(
+            params, pools, tables, lengths, tokens, cfg, fused=False
+        )
+        out, _ = paged_decode_step(
+            params, pools, tables, lengths, tokens, cfg, fused=True
+        )
+        diff = float(jnp.max(jnp.abs(out - ref)))
+        max_abs_diff = max(max_abs_diff, diff)
+        if diff > LOGITS_ATOL:
+            tol_violations += 1
+        out_p, _ = paged_decode_step(
+            params, poisoned, tables, lengths, tokens, cfg, fused=True
+        )
+        active = np.asarray(lengths) > 0
+        if active.any() and not np.array_equal(
+            np.asarray(out)[active], np.asarray(out_p)[active]
+        ):
+            poison_violations += 1
+
+    # PAIRED per-round timing: for every captured state, the two paths
+    # run back-to-back `reps` times and each keeps its per-state min —
+    # a host contention episode is bounded to one (state, rep) pair and
+    # can never eat one whole side (timing whole sides sequentially was
+    # measured to swing the ratio from 1.22x to 0.97x on this host)
+    po_g = _rand_pools(cfg, pcfg, seed=1)
+    po_f = _rand_pools(cfg, pcfg, seed=1)
+    tables, lengths, tokens = states[0]
+    l, po_g = gather_fn(params, po_g, tables, lengths, tokens)
+    jax.block_until_ready(l)  # compile off the clock
+    l, po_f = fused_fn(params, po_f, tables, lengths, tokens)
+    jax.block_until_ready(l)
+    g = f = 0.0
+    frontier_ms: dict = {}
+    bs = pcfg.block_size
+    for tables, lengths, tokens in states:
+        best_g = best_f = float("inf")
+        for _ in range(reps):
+            t0 = _now()
+            l, po_g = gather_fn(params, po_g, tables, lengths, tokens)
+            jax.block_until_ready(l)
+            best_g = min(best_g, _now() - t0)
+            t0 = _now()
+            l, po_f = fused_fn(params, po_f, tables, lengths, tokens)
+            jax.block_until_ready(l)
+            best_f = min(best_f, _now() - t0)
+        g += best_g
+        f += best_f
+        fr = int((np.asarray(lengths).max() + bs - 1) // bs)
+        agg = frontier_ms.setdefault(fr, [0.0, 0.0, 0])
+        agg[0] += best_g
+        agg[1] += best_f
+        agg[2] += 1
+    return {
+        "rounds_replayed": len(states),
+        "reps": reps,
+        "gather_round_ms": round(g / len(states) * 1e3, 4),
+        "fused_round_ms": round(f / len(states) * 1e3, 4),
+        "fused_speedup": round(g / f, 4),
+        # per-frontier honesty: the win shrinks as residency approaches
+        # the table width (the streamed walk converges on the same bytes)
+        "per_frontier": {
+            str(fr): {
+                "rounds": c,
+                "gather_ms": round(gg / c * 1e3, 3),
+                "fused_ms": round(ff / c * 1e3, 3),
+                "speedup": round(gg / ff, 3),
+            }
+            for fr, (gg, ff, c) in sorted(frontier_ms.items())
+        },
+        "fused_max_abs_diff": max_abs_diff,
+        "logits_atol": LOGITS_ATOL,
+        "tolerance_violations": tol_violations,
+        "poison_violations": poison_violations,
+    }
+
+
+# --------------------------------------------- on-demand vs reserve residency
+
+
+def run_admission_ab(cfg, params, pcfg, requests, admission: str,
+                     preempt: str = "swap") -> dict:
+    """One closed-batch run (everything submitted up front — residency is
+    what's under test, not arrival behavior): mean/peak concurrent
+    resident sequences, completion accounting, bitwise oracle."""
+    eng = ServingEngine(
+        params, cfg, pcfg,
+        BatcherConfig(slots=SLOTS, admission=admission, preempt=preempt),
+    )
+    eng.warmup(
+        sorted({r.prompt_len for r in requests}),
+        {pcfg.blocks_for(r.prompt_len + r.max_new_tokens) for r in requests},
+    )
+    for r in requests:
+        assert eng.submit(r), f"request {r.rid} rejected at submit"
+    t0 = _now()
+    residency = []
+    while not eng.idle:
+        eng.step()
+        residency.append(eng.batcher.num_active)
+    makespan = _now() - t0
+    # trailing rounds with a draining tail pull the mean down equally for
+    # both sides; keep only rounds with any resident work
+    busy = [r for r in residency if r > 0]
+    snap = eng.metrics.snapshot()["counters"]
+    oracle_violations = 0
+    for r in requests:
+        want = np.asarray(
+            generate(params, jnp.asarray(r.prompt)[None], cfg,
+                     max_new_tokens=r.max_new_tokens, max_len=pcfg.max_len)
+        )[0]
+        got = eng.completed.get(r.rid)
+        if got is None or not np.array_equal(got.tokens, want):
+            oracle_violations += 1
+    return {
+        "admission": admission,
+        "preempt": preempt,
+        "submitted": len(requests),
+        "completed": len(eng.completed),
+        "completed_unique": len(set(eng.completed)),
+        "mean_concurrency": round(float(np.mean(busy)), 3) if busy else 0.0,
+        "peak_concurrency": int(max(busy)) if busy else 0,
+        "preempts": int(snap.get("serve.preempts", 0)),
+        "resumes": int(snap.get("serve.resumes", 0)),
+        "swap_outs": int(snap.get("serve.swap_outs", 0)),
+        "admit_blocked": int(snap.get("serve.admit_blocked", 0)),
+        "oracle_violations": oracle_violations,
+        "makespan_s": round(makespan, 3),
+        "blocks_leaked": (pcfg.num_blocks - 1) - eng.batcher.allocator.num_free,
+    }
+
+
+# -------------------------------------------------------------------- main
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_PAGED.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small workload for CI minutes; timing floors "
+                    "reported, not enforced")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    t_start = _now()
+    n = 16 if args.smoke else 48
+    reps = 2 if args.smoke else 3
+
+    cfg, params = _model()
+    pcfg = _pcfg()
+    requests = build_workload(args.seed, n)
+
+    print(f"replaying decode rounds: {n} requests through the gather "
+          f"engine at slots={SLOTS}, pool={pcfg.num_blocks - 1} blocks",
+          flush=True)
+    states = capture_round_states(cfg, params, pcfg, requests)
+    replay = run_round_replay(cfg, params, pcfg, states, reps)
+    print(f"fused round: {replay['fused_round_ms']} ms vs gather "
+          f"{replay['gather_round_ms']} ms = {replay['fused_speedup']}x "
+          f"(max |dlogits| {replay['fused_max_abs_diff']:.2e})", flush=True)
+
+    # the admission A/B pool: small enough that RESERVATION is
+    # pool-bound (ceil((prompt+max)/bs) ~ 8-10 blocks x 8 slots needs
+    # ~70; 36 admits ~4) while on-demand stays slot-bound — equal pool
+    # memory on both sides, and tight enough to inject exhaustion into
+    # the on-demand run (the preemption scenario is the same run)
+    ab_pcfg = PagedCacheConfig(num_blocks=37, block_size=8,
+                               blocks_per_seq=10)
+    ab_requests = [
+        dataclasses.replace(r, max_new_tokens=max(r.max_new_tokens, 32))
+        for r in requests
+    ]
+    reserve = run_admission_ab(cfg, params, ab_pcfg, ab_requests, "reserve")
+    print(f"reserve:  mean {reserve['mean_concurrency']} / peak "
+          f"{reserve['peak_concurrency']} resident, "
+          f"{reserve['completed']}/{reserve['submitted']} done", flush=True)
+    ondemand = run_admission_ab(cfg, params, ab_pcfg, ab_requests, "ondemand")
+    print(f"ondemand: mean {ondemand['mean_concurrency']} / peak "
+          f"{ondemand['peak_concurrency']} resident, "
+          f"{ondemand['preempts']} preempts, "
+          f"{ondemand['completed']}/{ondemand['submitted']} done", flush=True)
+    recompute = run_admission_ab(
+        cfg, params, ab_pcfg, ab_requests[: max(8, n // 3)], "ondemand",
+        preempt="recompute",
+    )
+    print(f"recompute scenario: {recompute['preempts']} preempts, "
+          f"{recompute['oracle_violations']} oracle violations", flush=True)
+
+    gain = (
+        ondemand["mean_concurrency"] / reserve["mean_concurrency"]
+        if reserve["mean_concurrency"] else 0.0
+    )
+    peak_gain = (
+        ondemand["peak_concurrency"] / reserve["peak_concurrency"]
+        if reserve["peak_concurrency"] else 0.0
+    )
+
+    def scenario_ok(s, need_preempt):
+        return (
+            s["completed"] == s["completed_unique"] == s["submitted"]
+            and s["oracle_violations"] == 0
+            and s["blocks_leaked"] == 0
+            and (s["preempts"] >= 1 or not need_preempt)
+        )
+
+    enforce_timing = not args.smoke
+    floors = {
+        "fused_speedup": replay["fused_speedup"],
+        "min_fused_speedup": MIN_FUSED_SPEEDUP,
+        "timing_floors_enforced": enforce_timing,
+        "fused_speedup_ok": (
+            replay["fused_speedup"] >= MIN_FUSED_SPEEDUP
+            if enforce_timing else True
+        ),
+        "tolerance_violations": replay["tolerance_violations"],
+        "poison_violations": replay["poison_violations"],
+        "fused_correct_ok": (
+            replay["tolerance_violations"] == 0
+            and replay["poison_violations"] == 0
+        ),
+        "ondemand_concurrency_gain": round(gain, 3),
+        "ondemand_peak_gain": round(peak_gain, 3),
+        "min_concurrency_gain": MIN_CONCURRENCY_GAIN,
+        "concurrency_ok": gain >= MIN_CONCURRENCY_GAIN,
+        "preempt_swap_ok": scenario_ok(ondemand, need_preempt=True),
+        "preempt_recompute_ok": scenario_ok(recompute, need_preempt=True),
+        "reserve_baseline_ok": scenario_ok(reserve, need_preempt=False),
+    }
+    ok = bool(
+        floors["fused_speedup_ok"]
+        and floors["fused_correct_ok"]
+        and floors["concurrency_ok"]
+        and floors["preempt_swap_ok"]
+        and floors["preempt_recompute_ok"]
+        and floors["reserve_baseline_ok"]
+    )
+
+    doc = {
+        "bench": "paged_fused_decode_and_ondemand_admission",
+        "smoke": bool(args.smoke),
+        "host": {
+            "platform": platform.platform(),
+            "python": sys.version.split()[0],
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+        },
+        "config": {
+            "model": f"v{cfg.vocab_size}_d{cfg.d_model}_h{cfg.n_heads}"
+            f"_L{cfg.n_layers}_ff{cfg.d_ff}_f32",
+            "replay_cache": dataclasses.asdict(pcfg),
+            "admission_ab_cache": dataclasses.asdict(ab_pcfg),
+            "slots": SLOTS,
+            "n_requests": n,
+            "seed": args.seed,
+            "protocol": "real-run round replay, interleaved best-of "
+            "timing, tolerance+poison on every round",
+        },
+        "round_replay": replay,
+        "admission_reserve": reserve,
+        "admission_ondemand": ondemand,
+        "preempt_recompute": recompute,
+        "floors": floors,
+        "ok": ok,
+        "elapsed_s": round(_now() - t_start, 1),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps({
+        "ok": ok,
+        "fused_speedup": floors["fused_speedup"],
+        "ondemand_concurrency_gain": floors["ondemand_concurrency_gain"],
+    }))
+    if not ok:
+        print("MACHINE-CHECK FAILED; see floors in " + args.out,
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

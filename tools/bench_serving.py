@@ -158,7 +158,12 @@ def _latency_summary(records) -> dict:
 
 
 def run_continuous(cfg, params, pcfg, requests, slots: int) -> dict:
-    eng = ServingEngine(params, cfg, pcfg, BatcherConfig(slots=slots))
+    # fused=False EXPLICITLY: this bench's floor is the BITWISE
+    # paged-vs-generate gate, which only the gather path certifies — the
+    # engine's production default is the fused path, whose (tolerance +
+    # empirical token-equality) floors live in tools/bench_paged.py
+    eng = ServingEngine(params, cfg, pcfg, BatcherConfig(slots=slots),
+                        fused=False)
     eng.warmup(
         sorted({r.prompt_len for r in requests}),
         {pcfg.blocks_for(r.prompt_len + r.max_new_tokens) for r in requests},
@@ -347,7 +352,8 @@ def run_replica_kill(cfg, params, pcfg, n_requests: int, seed: int) -> dict:
     ]
     hb = tempfile.mkdtemp(prefix="ft_serving_hb_")
     engines = [
-        ServingEngine(params, cfg, pcfg, BatcherConfig(slots=2))
+        # gather path here too: the kill scenario's oracle is bitwise
+        ServingEngine(params, cfg, pcfg, BatcherConfig(slots=2), fused=False)
         for _ in range(2)
     ]
     for e in engines:
