@@ -817,6 +817,54 @@ def run_obs_tripwire(timeout_s: int = 300) -> dict:
         return {"obs_error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def run_feedback_tripwire(timeout_s: int = 600) -> dict:
+    """Supplementary keys ``planner_feedback_violations`` — the closed
+    planner-feedback loop exercised end-to-end on this exact tree
+    (ISSUE 12; 0 = a deliberately mis-calibrated start drift-detects,
+    refits, invalidates the stale plan-cache entry and replans in-run) —
+    and informational ``feedback_recovery_frac`` (the recovered step's
+    fraction of the oracle step time; its >= 0.90 floor is enforced only
+    in the committed full-run FEEDBACK.json — a CI container's
+    timeshared minute cannot hold a timing floor honestly).
+
+    Runs ``tools/feedback_convergence.py --smoke`` in a subprocess (it
+    pins its own 8-vdev CPU mesh); a driver that fails to run reports
+    ``feedback_error`` with the keys absent — absent reads as "not
+    verified", never as "clean".
+    """
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "feedback_convergence.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        out = {
+            "planner_feedback_violations": len(doc["violations"]),
+            "feedback_recovery_frac": doc["timing"]["recovery_frac"],
+        }
+        if p.returncode != 0 and not doc["violations"]:
+            # rc=1 WITH violations is the driver doing its job; rc!=0
+            # with a clean report means the driver itself malfunctioned
+            out["feedback_error"] = f"feedback_convergence rc={p.returncode}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"feedback_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -888,6 +936,7 @@ def main() -> int:
         result.update(run_serving_tripwire())
         result.update(run_paged_tripwire())
         result.update(run_obs_tripwire())
+        result.update(run_feedback_tripwire())
     print(json.dumps(result))
     return 0
 

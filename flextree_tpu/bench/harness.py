@@ -411,6 +411,12 @@ def _interleaved_times(calls: dict, repeat: int) -> dict:
         name: {
             "min_ms": min(ts) * 1e3,
             "avg_ms": sum(ts) / len(ts) * 1e3,
+            # raw per-round samples (round i of every variant ran in the
+            # same shuffled round), so callers can form PAIRED per-round
+            # statistics — on a heavily timeshared host the min of two
+            # variants' independent draws swings far more than any
+            # per-round ratio does
+            "times_ms": [t * 1e3 for t in ts],
         }
         for name, ts in times.items()
     }

@@ -38,10 +38,13 @@ from .recorder import (
     record_event,
 )
 from .timeline import (
+    ResidualSample,
     merge_dir,
     merge_events,
     read_dir,
     read_events,
+    residual_pairs,
+    residual_table,
     validate_trace,
     write_trace,
 )
@@ -64,6 +67,9 @@ __all__ = [
     "merge_events",
     "read_dir",
     "read_events",
+    "ResidualSample",
+    "residual_pairs",
+    "residual_table",
     "validate_trace",
     "write_trace",
 ]

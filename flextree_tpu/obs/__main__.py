@@ -5,13 +5,18 @@ Usage::
     python -m flextree_tpu.obs merge  OBS_DIR --out timeline.json
     python -m flextree_tpu.obs validate timeline.json
     python -m flextree_tpu.obs summary OBS_DIR
+    python -m flextree_tpu.obs residuals OBS_DIR
 
 ``merge`` fuses every ``flight_*.jsonl`` (+ ``*.dump.json``) under
 OBS_DIR into one timeline (ranks as tracks, requests/buckets as flows)
 and validates it before writing — a merge that would not load in
 Perfetto exits non-zero.  Open the result at https://ui.perfetto.dev or
 ``chrome://tracing``.  ``summary`` prints per-rank event/dump counts —
-the 10-second "what did this run leave behind".
+the 10-second "what did this run leave behind".  ``residuals`` prints
+the per-(topo, codec, tier) predicted-vs-measured comm residual table —
+the human-readable twin of ``planner.feedback``'s extractor, built from
+the SAME pairing code (``timeline.residual_pairs``) so the CLI and the
+fitter cannot diverge (docs/FEEDBACK.md).
 """
 
 from __future__ import annotations
@@ -21,7 +26,14 @@ import json
 import sys
 from collections import Counter as _Counter
 
-from .timeline import merge_events, read_dir, validate_trace, write_trace
+from .timeline import (
+    merge_events,
+    read_dir,
+    residual_pairs,
+    residual_table,
+    validate_trace,
+    write_trace,
+)
 
 
 def main(argv=None) -> int:
@@ -34,6 +46,11 @@ def main(argv=None) -> int:
     vp.add_argument("trace")
     sp = sub.add_parser("summary", help="per-rank event/dump counts")
     sp.add_argument("dir")
+    rp = sub.add_parser(
+        "residuals",
+        help="per-(topo, codec, tier) predicted-vs-measured residual table",
+    )
+    rp.add_argument("dir")
     args = ap.parse_args(argv)
 
     if args.cmd == "merge":
@@ -63,6 +80,15 @@ def main(argv=None) -> int:
         print(f"{args.trace}: {'INVALID' if bad else 'ok'} "
               f"({len(doc.get('traceEvents', []))} trace events)")
         return 1 if bad else 0
+
+    if args.cmd == "residuals":
+        events, _dumps = read_dir(args.dir)
+        if not events:
+            print(f"no flight_*.jsonl events under {args.dir}", file=sys.stderr)
+            return 1
+        samples, skipped = residual_pairs(events)
+        print(residual_table(samples, skipped))
+        return 0
 
     events, dumps = read_dir(args.dir)
     by_rank: dict[int, _Counter] = {}

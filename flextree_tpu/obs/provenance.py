@@ -63,6 +63,19 @@ def bucket_provenance(
     prov: dict = {
         "axes": list(axes),
         "topo": {ax: topo_spec(topos.get(ax)) for ax in axes},
+        # world size per axis (None for the native-psum sentinel, whose
+        # group size the resolved topology doesn't carry): the residual
+        # extractor pairs planned and measured spans on (topo, world,
+        # codec, sharded, nbytes) — without the world a "ring" spec is
+        # ambiguous across group sizes (planner/feedback.py)
+        "world": {
+            ax: (
+                int(topos.get(ax).num_nodes)
+                if topos.get(ax) is not None
+                else None
+            )
+            for ax in axes
+        },
         "nbytes": int(nbytes),
         "chunks": int(chunks),
         "codec": getattr(codec, "name", None) or (str(codec) if codec else "f32"),

@@ -30,9 +30,11 @@ Chrome-trace JSON" is machine-checked, not assumed.
 
 from __future__ import annotations
 
+import dataclasses
 import glob
 import json
 import os
+import statistics
 
 __all__ = [
     "read_events",
@@ -41,6 +43,9 @@ __all__ = [
     "merge_dir",
     "validate_trace",
     "write_trace",
+    "ResidualSample",
+    "residual_pairs",
+    "residual_table",
 ]
 
 #: kinds rendered on the heartbeat lane (tid 1) instead of the main lane
@@ -51,6 +56,12 @@ _START_SUFFIX, _END_SUFFIX = "_start", "_end"
 
 #: comm-plan kinds rendered as predicted-duration spans
 _PLAN_KINDS = frozenset({"bucket_planned", "bucket_fired", "collective"})
+
+#: measured-comm kinds (the feedback prober's timed collective runs,
+#: planner/feedback.py) rendered as spans whose duration is the MEASURED
+#: time — the twin of the comm-plan spans above, so Perfetto shows the
+#: prediction and the measurement side by side
+_MEASURED_KINDS = frozenset({"bucket_measured"})
 
 _META_KEYS = frozenset({"ts", "rank", "src", "seq", "kind"})
 
@@ -171,6 +182,21 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
                 {
                     "name": str(args.get("name", kind)),
                     "cat": "comm-plan",
+                    "ph": "X",
+                    **common,
+                    "dur": round(dur, 1),
+                    "args": args,
+                }
+            )
+            continue
+
+        if kind in _MEASURED_KINDS:
+            args = _args(ev)
+            dur = max(float(args.get("measured_us") or 1.0), 1.0)
+            trace.append(
+                {
+                    "name": str(args.get("name", kind)),
+                    "cat": "comm-measured",
                     "ph": "X",
                     **common,
                     "dur": round(dur, 1),
@@ -313,6 +339,195 @@ def validate_trace(doc) -> list[str]:
         if n < 0:
             bad.append(f"flow id {fid}: finish without start")
     return bad
+
+
+# ---------------------------------------------------------------------------
+# predicted-vs-measured residual query (planner feedback, ISSUE 12)
+#
+# ``bucket_planned`` events carry the planner's predicted CostBreakdown for
+# a comm span (obs/provenance.py — per-compile, the plan as priced);
+# ``bucket_measured`` events carry a MEASURED wall time for the same
+# (topo, world, codec, sharded, nbytes) point (the feedback prober's timed
+# collective runs, planner/feedback.py).  Pairing them yields the
+# predicted-vs-measured residual samples the closed-loop fitter consumes —
+# this module owns the pairing so the ``python -m flextree_tpu.obs
+# residuals`` CLI and ``planner.feedback``'s extractor share one code path
+# and cannot diverge.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidualSample:
+    """One predicted-vs-measured comm point read off a flight record."""
+
+    topo: str  # FT_TOPO-style spec of the axis's topology ("4,2", "ring")
+    world: int | None  # group size on that axis (None: unknown/psum)
+    codec: str
+    sharded: bool
+    nbytes: int
+    predicted_us: float
+    measured_us: float
+    fingerprint: str | None = None  # measuring backend, when recorded
+    step: int | None = None
+    ts: float | None = None
+    #: "paired" when the prediction came from a matching ``bucket_planned``
+    #: span; "self" when the measured event carried its own prediction
+    #: (the prober prices with the same model the planner used)
+    source: str = "paired"
+
+    @property
+    def rel_residual(self) -> float:
+        """|predicted - measured| / measured — the drift-band quantity."""
+        return abs(self.predicted_us - self.measured_us) / max(
+            self.measured_us, 1e-9
+        )
+
+
+def _plan_points(ev: dict):
+    """(topo_spec, world) per axis of a plan/measured event —
+    provenance records one event per axis (axes is a 1-tuple at both call
+    sites), but tolerate multi-axis payloads by yielding each axis.
+    Ring specs are normalized: provenance labels the ring topology
+    ``"ring"`` while the wire grammar's sentinel is ``"1"`` — the pairing
+    must treat them as one point."""
+    topo = ev.get("topo") or {}
+    world = ev.get("world") or {}
+    for ax in sorted(topo):
+        w = world.get(ax)
+        spec = str(topo[ax])
+        if spec == "1":
+            spec = "ring"
+        yield spec, (int(w) if w is not None else None)
+
+
+def _pairing_keys(ev: dict):
+    nbytes = ev.get("nbytes")
+    if nbytes is None:
+        return
+    for spec, world in _plan_points(ev):
+        yield (
+            spec,
+            world,
+            str(ev.get("codec", "f32")),
+            bool(ev.get("sharded", False)),
+            int(nbytes),
+        )
+
+
+def residual_pairs(events) -> tuple[list[ResidualSample], dict]:
+    """Pair ``bucket_planned`` predictions with ``bucket_measured`` times.
+
+    Returns ``(samples, skipped)`` where ``skipped`` counts events that
+    produced no sample and why: ``predicted_error`` (the cost model raised
+    at trace time — obs/provenance.py's never-break-a-trace path; such
+    spans are skipped, never crashed on), ``unpredicted`` (a measured
+    point with no prediction on either side), ``invalid_measured`` (a
+    measured event whose ``measured_us`` is missing or non-positive —
+    a torn write or producer bug, not a pairing gap), ``unmeasured_plans``
+    (planned spans that no probe ever measured — expected: plans are
+    per-compile, probes are per-tick).
+    """
+    skipped = {
+        "predicted_error": 0,
+        "unpredicted": 0,
+        "invalid_measured": 0,
+        "unmeasured_plans": 0,
+    }
+    predicted: dict[tuple, float] = {}
+    matched: set = set()
+    for ev in events:
+        if ev.get("kind") != "bucket_planned":
+            continue
+        if ev.get("predicted_error"):
+            skipped["predicted_error"] += 1
+            continue
+        pred = ev.get("predicted_us")
+        if not isinstance(pred, (int, float)):
+            continue  # a bare span with no costed prediction: nothing to pair
+        for key in _pairing_keys(ev):
+            # latest prediction wins: a recompile re-prices the same point
+            predicted[key] = float(pred)
+
+    samples: list[ResidualSample] = []
+    for ev in events:
+        if ev.get("kind") != "bucket_measured":
+            continue
+        meas = ev.get("measured_us")
+        if not isinstance(meas, (int, float)) or meas <= 0:
+            skipped["invalid_measured"] += 1
+            continue
+        keys = list(_pairing_keys(ev))
+        if not keys:
+            skipped["unpredicted"] += 1
+            continue
+        for key in keys:
+            spec, world, codec, sharded, nbytes = key
+            if key in predicted:
+                pred, source = predicted[key], "paired"
+                matched.add(key)
+            elif isinstance(ev.get("predicted_us"), (int, float)):
+                pred, source = float(ev["predicted_us"]), "self"
+            else:
+                skipped["unpredicted"] += 1
+                continue
+            samples.append(
+                ResidualSample(
+                    topo=spec,
+                    world=world,
+                    codec=codec,
+                    sharded=sharded,
+                    nbytes=nbytes,
+                    predicted_us=pred,
+                    measured_us=float(meas),
+                    fingerprint=ev.get("fingerprint"),
+                    step=ev.get("step"),
+                    ts=ev.get("ts"),
+                    source=source,
+                )
+            )
+    skipped["unmeasured_plans"] = len(set(predicted) - matched)
+    return samples, skipped
+
+
+def residual_table(samples, skipped: dict | None = None) -> str:
+    """Human-readable per-(topo, codec, tier) residual summary — the CLI
+    twin of the feedback fitter's extractor (``python -m flextree_tpu.obs
+    residuals DIR``).  ``tier`` is the group size plus the sharded flag
+    (the per-tier grouping the two-tier roadmap item will refine)."""
+    if not samples:
+        lines = ["no predicted-vs-measured residual pairs in this record"]
+        if skipped and skipped.get("unmeasured_plans"):
+            lines.append(
+                f"({skipped['unmeasured_plans']} planned span(s) were never "
+                "measured: run with the feedback prober on — "
+                "docs/FEEDBACK.md)"
+            )
+        return "\n".join(lines)
+
+    groups: dict[tuple, list[ResidualSample]] = {}
+    for s in samples:
+        tier = f"n{s.world if s.world is not None else '?'}" + (
+            "/sharded" if s.sharded else ""
+        )
+        groups.setdefault((s.topo, s.codec, tier), []).append(s)
+    head = (
+        f"{'topo':>10} {'codec':>6} {'tier':>10} {'count':>6} "
+        f"{'med pred':>10} {'med meas':>10} {'med |r|':>8} {'max |r|':>8}"
+    )
+    lines = [head, "-" * len(head)]
+    for (topo, codec, tier), grp in sorted(groups.items()):
+        lines.append(
+            f"{topo:>10} {codec:>6} {tier:>10} {len(grp):>6} "
+            f"{statistics.median(s.predicted_us for s in grp):>9.1f}u "
+            f"{statistics.median(s.measured_us for s in grp):>9.1f}u "
+            f"{statistics.median(s.rel_residual for s in grp):>8.3f} "
+            f"{max(s.rel_residual for s in grp):>8.3f}"
+        )
+    if skipped:
+        parts = [f"{k}={v}" for k, v in sorted(skipped.items()) if v]
+        if parts:
+            lines.append("skipped: " + ", ".join(parts))
+    return "\n".join(lines)
 
 
 def write_trace(doc: dict, path: str | os.PathLike) -> str:

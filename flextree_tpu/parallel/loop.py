@@ -140,6 +140,19 @@ class Supervision:
     ``background_saver``: a ``runtime.BackgroundSaver`` — periodic saves
     go through it instead of blocking the step path (the final save
     stays synchronous, after a drain).
+
+    ``feedback``: a ``planner.feedback.FeedbackController`` — the
+    closed-loop planner hook (ISSUE 12, docs/FEEDBACK.md).  Every
+    ``every_k`` steps *with the flight recorder on* it probes the live
+    wire, pairs measured against predicted comm cost, and — past the
+    drift band — refits the calibration constants, invalidates stale
+    plan-cache entries, and hands back a replanned step that ``fit``
+    swaps through the SAME rebuild path the shrink handler uses (its
+    ``on_replan`` hook returns the same 3-/5-tuple ``on_shrink`` does,
+    minus the restore: the world didn't change, only the plan).  With no
+    recorder installed the per-step cost is one ``None`` check — the
+    identical check ``record_event`` makes — so telemetry-off runs pay
+    nothing.
     """
 
     supervisor: Any = None
@@ -153,6 +166,7 @@ class Supervision:
     max_shrinks: int = 2
     preemption: Any = None
     background_saver: Any = None
+    feedback: Any = None
 
 
 @dataclasses.dataclass
@@ -169,6 +183,10 @@ class RunReport:
     step_timeouts: int = 0  # watchdog deadlines hit (FT_STEP_TIMEOUT)
     step_retries: int = 0  # timed-out steps retried (no death confirmed)
     stragglers: list = dataclasses.field(default_factory=list)
+    # --- closed-loop planner feedback (zero/empty without a controller) ---
+    feedback_refits: int = 0  # drift-triggered constant refits
+    feedback_replans: int = 0  # refits whose on_replan hook swapped the step
+    feedback_refusals: int = 0  # refits refused (starved/degenerate samples)
     # membership epochs: entry 0 is the starting world, one more per live
     # shrink — {"step", "alive", "configured", "topo", "dead"}
     membership_epochs: list = dataclasses.field(default_factory=list)
@@ -206,6 +224,21 @@ def _metrics_finite(metrics) -> bool:
             if not math.isfinite(v):
                 return False
     return True
+
+
+def _apply_rebuild(rebuilt, cur_pack, cur_unpack):
+    """Normalize a rebuild-hook result to the full 5-tuple swap.
+
+    Both step-swap seams — ``Supervision.on_shrink`` (world shrank) and
+    ``FeedbackConfig.on_replan`` (plan changed) — return either a
+    ``(step_fn, mesh, specs)`` 3-tuple or the re-shard path's 5-tuple
+    with the checkpoint-layout converters.  A 3-tuple keeps the current
+    converters; one helper owns the dispatch so the two swap paths
+    cannot diverge."""
+    if len(rebuilt) == 5:
+        return rebuilt
+    step_fn, mesh, specs = rebuilt
+    return step_fn, mesh, specs, cur_pack, cur_unpack
 
 
 def _stamp_step(state: dict, step: int) -> dict:
@@ -301,6 +334,7 @@ def fit(
     flagged_stragglers: set = set()
     shrinks = 0
     timeout_retries = 0
+    feedback_dead = False  # a tick raised: feedback disarmed for the run
     if sup is not None:
         from ..runtime.watchdog import StepTimeout, StepWatchdog, step_timeout_from_env
 
@@ -378,14 +412,12 @@ def fit(
                 sup.on_shrink(n_alive, plan) if sup.on_shrink is not None else None
             )
             if rebuilt is not None:
-                if len(rebuilt) == 5:
-                    # the re-shard path: the survivor world gets its own
-                    # checkpoint-layout converters (ZeRO state re-carved
-                    # from the consolidated checkpoint)
-                    (cur_step_fn, cur_mesh, cur_specs,
-                     cur_pack, cur_unpack) = rebuilt
-                else:
-                    cur_step_fn, cur_mesh, cur_specs = rebuilt
+                # 5-tuple = the re-shard path: the survivor world gets its
+                # own checkpoint-layout converters (ZeRO state re-carved
+                # from the consolidated checkpoint)
+                (cur_step_fn, cur_mesh, cur_specs,
+                 cur_pack, cur_unpack) = _apply_rebuild(
+                     rebuilt, cur_pack, cur_unpack)
             if cfg.ckpt_dir and latest_checkpoint(cfg.ckpt_dir):
                 state = _restore()
                 step = int(np.asarray(jax.device_get(state["step"])))
@@ -590,6 +622,65 @@ def fit(
             state = new_state
             bad_streak = 0
             step += 1
+            if (sup is not None and sup.feedback is not None
+                    and not feedback_dead and step < cfg.num_steps):
+                # closed-loop planner feedback (docs/FEEDBACK.md): with no
+                # recorder installed maybe_tick is ONE None check — the
+                # same check record_event makes — so telemetry-off runs
+                # pay nothing; on the every_k cadence it probes the wire,
+                # and past the drift band hands back a refitted replan.
+                # Gated on step < num_steps: a tick after the FINAL step
+                # would spend a probe round (and possibly a refit + full
+                # step rebuild) on a plan no step will ever run.
+                try:
+                    decision = sup.feedback.maybe_tick(step)
+                    if decision is not None:
+                        report.feedback_refits += 1
+                        if decision.rebuilt is not None:
+                            # the same swap the shrink path runs, minus the
+                            # restore: the world didn't change, only the plan
+                            (cur_step_fn, cur_mesh, cur_specs,
+                             cur_pack, cur_unpack) = _apply_rebuild(
+                                 decision.rebuilt, cur_pack, cur_unpack)
+                            report.feedback_replans += 1
+                        record_event(
+                            "feedback_replan",
+                            step=step,
+                            topo=decision.plan.to_ft_topo(),
+                            invalidated=decision.invalidated,
+                            swapped=decision.rebuilt is not None,
+                        )
+                        log.warning(
+                            "feedback replan at step %d: topo %s, %d cache "
+                            "entr%s invalidated%s",
+                            step, decision.plan.to_ft_topo(),
+                            decision.invalidated,
+                            "y" if decision.invalidated == 1 else "ies",
+                            "" if decision.rebuilt is not None
+                            else " (no rebuild hook: plan recorded only)",
+                        )
+                except Exception as e:
+                    # telemetry never kills the run (the obs contract:
+                    # spill errors drop, predicted_error spans skip) — an
+                    # unwritable calibration path, a failed probe compile,
+                    # or a broken rebuild hook disarms feedback for the
+                    # rest of the run and training continues on the
+                    # current plan.  A half-applied swap is impossible:
+                    # _apply_rebuild returns before any of the five
+                    # loop-state names is reassigned.
+                    feedback_dead = True
+                    # the reason must land in the FLIGHT record, not only
+                    # the process log: a later SIGKILL takes the log with
+                    # it while the spilled record survives (the same
+                    # post-mortem parity feedback_refused already has)
+                    record_event(
+                        "feedback_error", step=step,
+                        reason=f"{type(e).__name__}: {e}"[:300],
+                    )
+                    log.exception(
+                        "feedback tick failed at step %d; planner feedback "
+                        "disarmed for the rest of the run", step,
+                    )
             if cfg.log_every and (step % cfg.log_every == 0 or step == cfg.num_steps):
                 loss = float(metrics["loss"])
                 losses.append((step, loss))
@@ -615,6 +706,12 @@ def fit(
                 )
     finally:
         if sup is not None:
+            if sup.feedback is not None:
+                # refusals happen inside the controller (a refused refit
+                # returns no decision); mirror its count into the report
+                report.feedback_refusals = getattr(
+                    sup.feedback, "refusals", 0
+                )
             if sup.background_saver is not None:
                 sup.background_saver.drain()
                 report.background_saves = sup.background_saver.saves
@@ -635,6 +732,9 @@ def fit(
                 max(len(report.membership_epochs) - 1, 0)
             )
             reg.counter("train.background_saves").inc(report.background_saves)
+            reg.counter("train.feedback_refits").inc(report.feedback_refits)
+            reg.counter("train.feedback_replans").inc(report.feedback_replans)
+            reg.counter("train.feedback_refusals").inc(report.feedback_refusals)
             reg.gauge("train.last_step").set(step)
             report.metrics = reg.snapshot()
         record_event("fit_end", id=start, step=step)

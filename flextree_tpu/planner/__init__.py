@@ -39,6 +39,18 @@ from .autotune import (
     TunedPlan,
     analytic_shortlist,
     autotune_plan,
+    invalidate_plan_cache,
+)
+from .feedback import (
+    DriftDetector,
+    FeedbackConfig,
+    FeedbackController,
+    FeedbackRefused,
+    ProbePoint,
+    ReplanDecision,
+    cache_invalidation_predicate,
+    extract_residuals,
+    fit_from_samples,
 )
 from .choose import (
     Candidate,
@@ -87,7 +99,17 @@ __all__ = [
     "TunedPlan",
     "analytic_shortlist",
     "autotune_plan",
+    "invalidate_plan_cache",
     "DEFAULT_CODECS",
+    "DriftDetector",
+    "FeedbackConfig",
+    "FeedbackController",
+    "FeedbackRefused",
+    "ProbePoint",
+    "ReplanDecision",
+    "cache_invalidation_predicate",
+    "extract_residuals",
+    "fit_from_samples",
     "Candidate",
     "Plan",
     "candidate_topologies",

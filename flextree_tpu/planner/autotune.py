@@ -16,7 +16,9 @@ host/chip is never silently replayed on another; a fingerprint mismatch
 is a miss and the candidates are re-measured.  The cache file is JSON
 (an explicit ``cache_path``, else ``FLEXTREE_PLAN_CACHE``, else the
 user-level :data:`DEFAULT_CACHE_PATH` — persistence must hold out of the
-box), one entry per key, schema-versioned like CALIBRATION.json.
+box), one entry per key, schema-versioned by :data:`PLAN_CACHE_SCHEMA` (its
+own constant — calibration-file schema bumps must not orphan plan
+caches under older checkouts).
 
 The measured winner can only improve on the analytic argmin: the argmin
 is always in the shortlist, so ``min(measured)`` is never slower than the
@@ -35,17 +37,26 @@ from dataclasses import dataclass
 from ..schedule.ir import IRFamilySpec
 from ..schedule.stages import LonelyTopology, Topology
 from .calibrate import (
-    CALIBRATION_SCHEMA,
     backend_fingerprint,
     default_params,
     plan_cache_key,
 )
 from .choose import choose_topology
 
+#: Plan-cache file schema — deliberately DECOUPLED from
+#: ``calibrate.CALIBRATION_SCHEMA``: the two files evolve independently,
+#: and stamping plan caches with the calibration constant would make a
+#: calibration-only bump (e.g. schema 4's provenance ``source`` stamp)
+#: silently discard — and on the next rewrite destroy — a fresh plan
+#: cache under any older checkout sharing the user-level cache file.
+#: Bump this one only when the plan-cache ENTRY format itself changes.
+PLAN_CACHE_SCHEMA = 3
+
 __all__ = [
     "TunedPlan",
     "analytic_shortlist",
     "autotune_plan",
+    "invalidate_plan_cache",
     "DEFAULT_CODECS",
     "DEFAULT_IR_FAMILIES",
 ]
@@ -181,14 +192,14 @@ def _cache_path(cache_path):
 
 def _cache_load(path) -> dict:
     if not path or not os.path.exists(path):
-        return {"schema": CALIBRATION_SCHEMA, "entries": {}}
+        return {"schema": PLAN_CACHE_SCHEMA, "entries": {}}
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError):
-        return {"schema": CALIBRATION_SCHEMA, "entries": {}}
-    if doc.get("schema", 1) > CALIBRATION_SCHEMA:
-        return {"schema": CALIBRATION_SCHEMA, "entries": {}}
+        return {"schema": PLAN_CACHE_SCHEMA, "entries": {}}
+    if doc.get("schema", 1) > PLAN_CACHE_SCHEMA:
+        return {"schema": PLAN_CACHE_SCHEMA, "entries": {}}
     doc.setdefault("entries", {})
     return doc
 
@@ -199,6 +210,39 @@ def _cache_store(path, doc) -> None:
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
     os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+
+
+def invalidate_plan_cache(predicate, cache_path=None) -> int:
+    """Drop plan-cache entries matching ``predicate(key, entry) -> bool``;
+    returns how many were removed.
+
+    The drift-invalidation seam of the closed feedback loop
+    (``planner/feedback.py``, ISSUE 12): when measured comm residuals show
+    a cached plan was priced by stale constants, the matching entries are
+    removed so the next ``maybe_autotune_grad_topo`` / ``autotune_plan``
+    call **re-measures** the shortlist instead of riding the stale winner.
+    ``predicate`` receives the flat cache key string
+    (:func:`~flextree_tpu.planner.calibrate.plan_cache_key` layout) and
+    the stored entry dict (which carries the measuring ``fingerprint``) —
+    :func:`flextree_tpu.planner.feedback.cache_invalidation_predicate`
+    builds the standard fingerprint+world matcher.  A missing/empty cache
+    is a no-op (0), and an untouched cache file is not rewritten.
+    """
+    path = _cache_path(cache_path)
+    if not path or not os.path.exists(path):
+        return 0
+    doc = _cache_load(path)
+    keep = {}
+    removed = 0
+    for key, entry in doc["entries"].items():
+        if predicate(key, entry):
+            removed += 1
+        else:
+            keep[key] = entry
+    if removed:
+        doc["entries"] = keep
+        _cache_store(path, doc)
+    return removed
 
 
 # ------------------------------------------------------------ measure

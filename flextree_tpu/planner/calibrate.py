@@ -54,9 +54,13 @@ __all__ = [
 #: loaders refuse sections from a NEWER schema rather than misparse them.
 #: Schema 3 adds the split-collective per-phase bandwidth scales
 #: (``rs_bw_scale``/``ag_bw_scale``, arXiv:2409.04202's two-halves
-#: costing); schema-2 sections still load, with the neutral defaults and
-#: a logged notice (never silently).
-CALIBRATION_SCHEMA = 3
+#: costing); schema 4 adds the provenance ``source`` stamp
+#: ("measured" = tools/calibrate_host.py's direct measurement protocol,
+#: "feedback" = the closed-loop refit from flight-record residuals,
+#: planner/feedback.py — with sample count and source-run id in ``meta``).
+#: Older sections still load, with the neutral defaults and a logged
+#: notice (never silently).
+CALIBRATION_SCHEMA = 4
 
 
 def backend_fingerprint() -> str | None:
@@ -321,6 +325,7 @@ def save_calibration(
     backend: str,
     meta: dict | None = None,
     fingerprint: str | None = None,
+    source: str = "measured",
 ) -> None:
     """Write/merge the ``backend`` section of a CALIBRATION.json file.
 
@@ -328,11 +333,15 @@ def save_calibration(
     measured points, date) — the file is a committed artifact and each
     constant must be traceable to a measurement or labeled as a default.
 
-    Every section is stamped with ``schema`` (:data:`CALIBRATION_SCHEMA`)
-    and the measuring backend's ``fingerprint``
-    (:func:`backend_fingerprint` unless given explicitly), so a fit from
+    Every section is stamped with ``schema`` (:data:`CALIBRATION_SCHEMA`),
+    the measuring backend's ``fingerprint``
+    (:func:`backend_fingerprint` unless given explicitly) so a fit from
     one host is never silently reused on another — ``load_calibration``
-    rejects mismatches.
+    rejects mismatches — and a provenance ``source``: ``"measured"`` (the
+    direct-measurement protocol of ``tools/calibrate_host.py``) or
+    ``"feedback"`` (the closed-loop refit from flight-record residuals,
+    ``planner/feedback.py`` — its ``meta`` carries the sample count and
+    the source-run id).
     """
     import json
     import os
@@ -344,6 +353,7 @@ def save_calibration(
     doc[backend] = {
         "schema": CALIBRATION_SCHEMA,
         "fingerprint": fingerprint or backend_fingerprint(),
+        "source": source,
         "params": _params_to_dict(params),
         "meta": meta or {},
     }
@@ -395,21 +405,33 @@ def load_calibration(
             path, backend, sec.get("schema"), CALIBRATION_SCHEMA,
         )
         return None
+    # provenance source stamp (schema 4): pre-stamp sections load — the
+    # established older-sections-load-non-silently contract — but say so,
+    # and every mismatch warning below names where the constants came from
+    source = sec.get("source")
+    if source is None:
+        log.info(
+            "calibration %s section %r predates source stamping "
+            "(schema < 4); re-run tools/calibrate_host.py to record "
+            "whether these constants are measured or feedback-fitted",
+            path, backend,
+        )
+        source = "unstamped"
     saved_fp = sec.get("fingerprint")
     if saved_fp is None:
         log.warning(
-            "calibration %s section %r predates fingerprinting; loading "
-            "unverified (re-run tools/calibrate_host.py to stamp it)",
-            path, backend,
+            "calibration %s section %r (source=%s) predates fingerprinting; "
+            "loading unverified (re-run tools/calibrate_host.py to stamp it)",
+            path, backend, source,
         )
     else:
         current_fp = fingerprint or backend_fingerprint()
         if current_fp is not None and current_fp != saved_fp:
             log.warning(
-                "calibration %s section %r was fitted on %r but this "
-                "backend is %r; ignoring it (re-run tools/calibrate_host.py "
-                "on this host)",
-                path, backend, saved_fp, current_fp,
+                "calibration %s section %r (source=%s) was fitted on %r but "
+                "this backend is %r; ignoring it (re-run "
+                "tools/calibrate_host.py on this host)",
+                path, backend, source, saved_fp, current_fp,
             )
             return None
     return _params_from_dict(sec["params"])

@@ -1,0 +1,1017 @@
+"""Closed-loop planner feedback: fit constants from flight records,
+detect drift, replan in-run (ISSUE 12).
+
+PR 10 made every gradient-bucket comm span carry its plan provenance plus
+the planner's predicted ``CostBreakdown`` — and nothing consumed the
+predicted-vs-measured residuals, so a run that started on a
+mis-calibrated host rode the wrong plan forever.  This module closes the
+loop, per the "Revisiting the Time Cost Model of AllReduce" treatment
+(arXiv:2409.04202: α-β models must be anchored to measurement, and
+RE-anchored when the measurement disagrees):
+
+1. **Residual extraction** (:func:`extract_residuals`): read a run's
+   per-rank ``flight_*.jsonl`` files and pair each provenance-annotated
+   ``bucket_planned`` span's prediction against the measured
+   ``bucket_measured`` time at the same (topo, world, codec, sharded,
+   nbytes) point.  The pairing itself lives in
+   ``obs/timeline.py::residual_pairs`` so the ``python -m
+   flextree_tpu.obs residuals`` CLI and this fitter share one code path.
+2. **Fitting** (:func:`fit_from_samples`): convert the residual samples
+   into the :class:`~flextree_tpu.planner.calibrate.MeasuredPoint` form
+   ``fit_cost_params`` consumes and solve for updated α-β constants —
+   re-using ``calibrate.feature_vector``'s model-derived feature matrix,
+   so the refit can never drift out of sync with the cost formulas —
+   plus a codec-throughput rescale from compressed samples and a
+   bwd-GFLOPs update from compute probes when available.  Starved or
+   degenerate sample sets are REFUSED loudly (:class:`FeedbackRefused`):
+   a fit from 3 points, or from one shape measured 50 times, would hand
+   the planner a confident lie.
+3. **Drift detection** (:class:`DriftDetector`): per-(fingerprint,
+   world, topo family, codec, sharded) sliding windows of relative
+   residuals; the band breach is the replan trigger, and it also
+   invalidates matching autotune plan-cache entries
+   (``autotune.invalidate_plan_cache``) so the next measured search
+   re-measures instead of riding the stale winner.
+4. **In-run replanning** (:class:`FeedbackController`):
+   ``fit(supervision=Supervision(feedback=...))`` ticks the controller
+   every ``every_k`` steps; with the flight recorder on it times a small
+   probe set on the live wire, feeds the detector, and — past the band —
+   refits, writes the constants back through ``save_calibration``
+   (``source="feedback"``), invalidates the plan cache, re-runs
+   ``choose_topology`` with the refitted constants and hands ``fit`` a
+   rebuilt step through the same swap path ``replan_for_survivors``
+   exercises for shrink.  With the recorder off the tick is ONE ``None``
+   check (the same check ``record_event`` makes) — zero new overhead,
+   machine-checked by ``tools/feedback_convergence.py``.
+
+Honest limits (docs/FEEDBACK.md): probes measure the collective ALONE on
+the live backend — in-step contention is not in the sample (the overlap
+planner's pessimism band covers that seam); one-address-space memcpy
+wires produce residuals whose bandwidth/latency split the fit cannot
+attribute (the same negative control BENCH_QUANT documents); and lonely
+``+k`` shapes have no feature row, so their samples inform drift but not
+the α-β solve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ..obs.recorder import current_recorder, record_event
+from ..obs.timeline import (
+    ResidualSample,
+    read_dir,
+    residual_pairs,
+    residual_table,
+)
+from ..schedule.stages import Topology
+from ..utils.logging import get_logger
+from .autotune import invalidate_plan_cache
+from .calibrate import (
+    MeasuredPoint,
+    backend_fingerprint,
+    default_params,
+    feature_vector,
+    fit_cost_params,
+    save_calibration,
+)
+from .choose import choose_topology
+from .cost_model import (
+    LinkParams,
+    TpuCostParams,
+    allreduce_cost,
+    lonely_allreduce_cost,
+)
+
+__all__ = [
+    "FeedbackRefused",
+    "ProbePoint",
+    "ReplanDecision",
+    "FeedbackConfig",
+    "FeedbackController",
+    "DriftDetector",
+    "extract_residuals",
+    "residual_report",
+    "samples_to_points",
+    "fit_from_samples",
+    "fit_bwd_gflops",
+    "predict_spec_us",
+    "sample_family",
+    "default_probe_points",
+    "cache_invalidation_predicate",
+]
+
+log = get_logger("flextree.feedback")
+
+
+class FeedbackRefused(RuntimeError):
+    """The residual set cannot support a fit: starved (too few samples /
+    too few distinct points) or degenerate (ill-conditioned feature
+    matrix, or the NNLS active set emptied).  Raised LOUDLY — the
+    alternative, fitting anyway, hands the planner confident garbage,
+    which is strictly worse than the stale constants it already has."""
+
+
+# --------------------------------------------------------------- extraction
+
+
+def extract_residuals(obs_dir: str) -> tuple[list[ResidualSample], dict]:
+    """Predicted-vs-measured residual samples from a run's flight record
+    (every ``flight_*.jsonl`` under ``obs_dir``) — the shared pairing of
+    ``obs.timeline.residual_pairs``, so this extractor and the
+    ``python -m flextree_tpu.obs residuals`` CLI cannot diverge."""
+    events, _dumps = read_dir(obs_dir)
+    return residual_pairs(events)
+
+
+def residual_report(obs_dir: str) -> str:
+    """The CLI table for a recorded run's directory."""
+    samples, skipped = extract_residuals(obs_dir)
+    return residual_table(samples, skipped)
+
+
+def _parse_spec(spec: str) -> tuple[tuple[int, ...] | None, int]:
+    """FT_TOPO-style spec -> (widths, lonely); ``(None, 0)`` for specs
+    with no cost-model row (the native-psum sentinel)."""
+    spec = str(spec).strip()
+    if spec in ("psum", ""):
+        return None, 0
+    if spec in ("ring", "1"):
+        return (1,), 0
+    lonely = 0
+    if "+" in spec:
+        spec, tail = spec.rsplit("+", 1)
+        lonely = int(tail)
+    widths = tuple(int(w) for w in spec.replace("*", ",").split(","))
+    if any(w == 1 for w in widths):
+        return (1,), lonely
+    return widths, lonely
+
+
+def sample_family(sample: ResidualSample) -> str:
+    """Topology family of a residual sample: "ring" / "lonely" / "tree"
+    (or "psum" for the native sentinel) — the drift detector's grouping
+    granularity."""
+    widths, lonely = _parse_spec(sample.topo)
+    if widths is None:
+        return "psum"
+    if lonely:
+        return "lonely"
+    return "ring" if widths == (1,) else "tree"
+
+
+def predict_spec_us(
+    spec: str,
+    n: int,
+    nbytes: int,
+    params: TpuCostParams | None = None,
+    codec: str | None = None,
+) -> float | None:
+    """Predicted allreduce time for an FT_TOPO spec — priced by the SAME
+    ``allreduce_cost`` the fit's ``feature_vector`` evaluates, so probe
+    residuals and the solve agree on the model.  None for specs the model
+    has no row for (psum)."""
+    if params is None:
+        params = default_params()
+    widths, lonely = _parse_spec(spec)
+    if widths is None:
+        return None
+    codec_obj = None
+    if codec and codec != "f32":
+        from ..ops.quantize import get_codec
+
+        codec_obj = get_codec(codec)
+    if lonely:
+        tree = Topology(n - lonely, widths)
+        return lonely_allreduce_cost(
+            tree, lonely, nbytes, params, codec=codec_obj
+        ).total_us
+    topo = Topology.ring(n) if widths == (1,) else Topology(n, widths)
+    return allreduce_cost(topo, nbytes, params, codec=codec_obj).total_us
+
+
+# ------------------------------------------------------------------ fitting
+
+
+def samples_to_points(samples) -> list[MeasuredPoint]:
+    """Residual samples -> the ``MeasuredPoint`` form ``fit_cost_params``
+    consumes.  Only samples with a feature row qualify: identity codec
+    (compressed wires fold codec time into the measurement — they feed
+    the codec rescale instead), unsharded, known world, and tree/ring
+    shapes (lonely ``+k`` folds have no ``feature_vector`` row)."""
+    points = []
+    for s in samples:
+        if s.codec != "f32" or s.sharded or s.world is None:
+            continue
+        widths, lonely = _parse_spec(s.topo)
+        if widths is None or lonely:
+            continue
+        points.append(MeasuredPoint(widths, s.world, s.nbytes, s.measured_us))
+    return points
+
+
+def fit_bwd_gflops(compute_samples) -> float | None:
+    """Median achieved backward GFLOP/s from ``(flops, seconds)`` compute
+    probes (>= 2 positive samples required), or None — the overlap
+    boundary equalizer's absolute compute scale.  Compute probes need a
+    sync-free step to time (``bench.harness.make_nosync_train_step``);
+    runs without one keep the backend-resolved default, documented in
+    docs/FEEDBACK.md."""
+    rates = [
+        flops / seconds / 1e9
+        for flops, seconds in compute_samples
+        if flops > 0 and seconds > 0
+    ]
+    if len(rates) < 2:
+        return None
+    return float(np.median(rates))
+
+
+def fit_from_samples(
+    samples,
+    *,
+    base_params: TpuCostParams | None = None,
+    min_samples: int = 8,
+    min_distinct: int = 4,
+    max_condition: float = 1e8,
+    compute_samples=(),
+) -> tuple[TpuCostParams, dict]:
+    """Solve updated cost constants from flight-record residual samples.
+
+    α-β half: :func:`samples_to_points` + ``calibrate.fit_cost_params``
+    (relative NNLS over the model-derived feature matrix).  Guards, all
+    raising :class:`FeedbackRefused`:
+
+    - **starved**: fewer than ``min_samples`` eligible samples, or fewer
+      than ``min_distinct`` distinct (widths, world, nbytes) points —
+      four constants fitted from three points is interpolation theater;
+    - **degenerate**: the relative-weighted feature matrix's condition
+      number exceeds ``max_condition`` (one shape measured many times
+      spans a line, not the 4-dim feature space), or ``fit_cost_params``
+      itself empties its NNLS active set (measurements contradict the
+      model everywhere).
+
+    Codec half (:func:`_refit_codec`): the α-β solve cannot split the
+    byte slope between wire and reduce bandwidth — those features are
+    structurally collinear on an f32 wire — but compressed samples
+    *can*: an int8 hop moves ¼ the wire bytes while reducing the same
+    f32 bytes, so the compressed residual set jointly identifies the
+    wire/reduce split AND ``codec_bw_GBps`` (a 2-unknown constrained
+    least squares holding the f32-identified combined slope fixed).
+    Skipped with a ``meta`` note when the set is too small, degenerate,
+    or the codec excess is non-positive — the memcpy-wire case where
+    codec time is unattributable.  ``compute_samples`` optionally update
+    ``bwd_GFLOPs`` (:func:`fit_bwd_gflops`).
+
+    Returns ``(params, meta)`` where ``meta`` records counts/condition —
+    the provenance trail ``save_calibration(source="feedback")`` embeds.
+    """
+    if base_params is None:
+        base_params = default_params()
+    # materialize once: a generator would be exhausted by fit_bwd_gflops
+    # before the meta sample count below re-iterates it
+    compute_samples = tuple(compute_samples)
+    points = samples_to_points(samples)
+    if len(points) < min_samples:
+        raise FeedbackRefused(
+            f"starved residual set: {len(points)} eligible sample(s) < "
+            f"min_samples={min_samples} (identity-codec, unsharded, "
+            "tree/ring samples with a known world qualify)"
+        )
+    distinct = {(p.widths, p.num_nodes, p.nbytes) for p in points}
+    if len(distinct) < min_distinct:
+        raise FeedbackRefused(
+            f"starved residual set: {len(distinct)} distinct "
+            f"(shape, world, nbytes) point(s) < min_distinct={min_distinct} "
+            "— re-measuring one point cannot pin 4 constants"
+        )
+    X = np.stack(
+        [feature_vector(p.widths, p.num_nodes, p.nbytes) for p in points]
+    )
+    y = np.array([p.measured_us for p in points])
+    Xw = X / np.maximum(y, 1e-9)[:, None]  # fit_cost_params' relative rows
+    # Conditioning guard, on the COLUMN-NORMALIZED matrix (the raw
+    # features carry wildly different units — launch counts ~1 vs byte
+    # terms ~1e6 — which inflates a naive condition number without making
+    # the solve degenerate).  Note the model's bandwidth and reduce
+    # features are STRUCTURALLY collinear on a uniform fabric (the
+    # telescoping identity makes both byte sums shape-independent,
+    # cost_model.py docstring), so full rank 4 is unattainable by design;
+    # the fit only needs the 3 identifiable directions (launch, latency,
+    # combined byte slope).  Refuse when the measured geometry spans
+    # fewer — one shape re-measured many times spans a line — or when the
+    # spanned directions are themselves near-dependent.
+    col_scale = np.abs(Xw).max(axis=0)
+    live = col_scale > 1e-12
+    sv = np.linalg.svd(Xw[:, live] / col_scale[live], compute_uv=False)
+    need = min(3, int(live.sum()))
+    rank = int((sv > sv[0] * 1e-10).sum()) if sv.size else 0
+    cond = float(sv[0] / sv[need - 1]) if rank >= need else float("inf")
+    if rank < need or cond > max_condition:
+        raise FeedbackRefused(
+            f"degenerate residual set: measured points span {rank} of the "
+            f"{need} identifiable feature directions (condition "
+            f"{cond:.3g} vs max {max_condition:.3g}) — add shapes/sizes "
+            "instead of re-measuring the same point"
+        )
+    try:
+        fitted = fit_cost_params(points)
+    except RuntimeError as e:  # the NNLS empty-active-set refusal
+        raise FeedbackRefused(f"degenerate residual set: {e}") from e
+
+    meta: dict = {
+        "points": len(points),
+        "distinct_points": len(distinct),
+        "condition": round(cond, 3),
+    }
+
+    # preserve constants the α-β solve does not see
+    fitted = dataclasses.replace(
+        fitted,
+        codec_bw_GBps=base_params.codec_bw_GBps,
+        bwd_GFLOPs=base_params.bwd_GFLOPs,
+        rs_bw_scale=base_params.rs_bw_scale,
+        ag_bw_scale=base_params.ag_bw_scale,
+    )
+    # The f32 data pins only the COMBINED byte slope (wire and reduce
+    # features are structurally collinear — see the conditioning note
+    # above), so the NNLS split between them is arbitrary.  Normalize to
+    # the base calibration's ratio: every f32 prediction is unchanged,
+    # and compressed-wire predictions stay anchored to the last measured
+    # split instead of jumping with solver round-off.  Compressed samples
+    # below re-solve the split from evidence when they can.
+    fitted = _resplit_bytes(fitted, base_params, points[0])
+
+    # ---- codec + wire-split refit from compressed samples
+    fitted, codec_meta = _refit_codec(samples, fitted, points)
+    meta.update(codec_meta)
+
+    # ---- backward-compute scale from compute probes
+    bwd = fit_bwd_gflops(compute_samples)
+    if bwd is not None:
+        fitted = dataclasses.replace(fitted, bwd_GFLOPs=bwd)
+        meta["bwd_GFLOPs"] = round(bwd, 3)
+        meta["compute_samples"] = len(compute_samples)
+    return fitted, meta
+
+
+def _resplit_bytes(
+    fitted: TpuCostParams, base: TpuCostParams, p0: MeasuredPoint
+) -> TpuCostParams:
+    """Redistribute the f32-identified combined byte slope ``q = c·inv_bw
+    + inv_rbw`` between wire and reduce bandwidth in ``base``'s ratio —
+    an f32-prediction-preserving change of the one direction the f32 fit
+    cannot see (``c`` is the fixed wire/reduce feature ratio, evaluated
+    from the model at ``p0``)."""
+    tiny = 1e-12
+    fv = feature_vector(p0.widths, p0.num_nodes, p0.nbytes)
+    if fv[3] <= tiny:
+        return fitted
+    c = float(fv[2] / fv[3])
+    inv_bw = 1.0 / max(fitted.ici.bandwidth_GBps * 1e3, tiny)
+    inv_rbw = 1.0 / max(fitted.reduce_bw_GBps * 1e3, tiny)
+    q = c * inv_bw + inv_rbw
+    base_inv_bw = 1.0 / max(base.ici.bandwidth_GBps * 1e3, tiny)
+    base_inv_rbw = 1.0 / max(base.reduce_bw_GBps * 1e3, tiny)
+    denom = c * base_inv_bw + base_inv_rbw
+    if q <= tiny or denom <= tiny:
+        return fitted
+    scale = q / denom
+    bw = 1.0 / max(base_inv_bw * scale, tiny) / 1e3
+    return dataclasses.replace(
+        fitted,
+        ici=LinkParams(bandwidth_GBps=bw, latency_us=fitted.ici.latency_us),
+        dcn=LinkParams(bandwidth_GBps=bw, latency_us=fitted.dcn.latency_us),
+        reduce_bw_GBps=1.0 / max(base_inv_rbw * scale, tiny) / 1e3,
+    )
+
+
+def _codec_feature_basis() -> list[TpuCostParams]:
+    """``calibrate._params_basis`` extended with a codec one-hot: 5
+    settings s.t. ``allreduce_cost(..., p_i, codec=c).total_us`` is the
+    i-th feature of the codec-aware model (launch, latency, inv wire bw,
+    inv reduce bw, inv codec bw).  The α-β entries pin ``codec_bw`` to
+    "infinite" so their features stay pure."""
+    from .calibrate import _params_basis
+
+    big = 1e30
+    base = [
+        dataclasses.replace(p, codec_bw_GBps=big) for p in _params_basis()
+    ]
+    codec_one = dataclasses.replace(
+        base[0], launch_us=0.0, codec_bw_GBps=1e-3
+    )
+    return base + [codec_one]
+
+
+def _refit_codec(samples, fitted, points) -> tuple[TpuCostParams, dict]:
+    """Joint wire-split + codec-throughput solve from compressed samples.
+
+    The f32 α-β fit identifies launch, latency, and the COMBINED byte
+    slope ``q = c·inv_bw + inv_rbw`` (wire and reduce features are
+    structurally collinear on an f32 wire, ``c`` their fixed ratio) — but
+    not the split, and the split is exactly what prices a compressed
+    wire: int8 moves ``ratio``× the wire bytes while reducing and
+    en/decoding full f32 bytes.  Each compressed sample therefore gives
+
+        meas − launch·A_launch − lat·A_lat − q·A_rbw
+            = inv_bw·(A_bw − c·A_rbw) + inv_codec·A_codec
+
+    with the A's evaluated by the SAME cost model at one-hot basis params
+    (:func:`_codec_feature_basis`).  Two unknowns, relative-weighted
+    least squares, ``inv_bw`` clamped to ``[0, q/c]`` so the implied
+    reduce bandwidth stays non-negative.  Refuses (returns the params
+    untouched plus a ``codec_refit: skipped`` note) on < 3 usable
+    samples, a rank-deficient system (one shape at one size cannot
+    separate wire savings from codec work), or a non-positive codec
+    inverse — measured compressed time at/below the α-β floor, the
+    memcpy-wire case where codec time is unattributable."""
+    lossy = [s for s in samples if s.codec != "f32" and not s.sharded]
+    if not lossy:
+        return fitted, {}
+    from ..ops.quantize import get_codec
+
+    basis = _codec_feature_basis()
+    rows, meas = [], []
+    for s in lossy:
+        if s.world is None:
+            continue
+        widths, lonely = _parse_spec(s.topo)
+        if widths is None or lonely:
+            continue
+        try:
+            codec_obj = get_codec(s.codec)
+        except (KeyError, ValueError):
+            continue
+        topo = (
+            Topology.ring(s.world)
+            if widths == (1,)
+            else Topology(s.world, widths)
+        )
+        rows.append(
+            np.array(
+                [
+                    allreduce_cost(topo, s.nbytes, p, codec=codec_obj).total_us
+                    for p in basis
+                ]
+            )
+        )
+        meas.append(s.measured_us)
+
+    def skipped(reason: str) -> tuple[TpuCostParams, dict]:
+        return fitted, {
+            "codec_refit": (
+                f"skipped: {reason} — codec time unattributable on this wire"
+            )
+        }
+
+    if len(rows) < 3:
+        return skipped(
+            f"{len(rows)}/{len(lossy)} usable compressed sample(s) (< 3)"
+        )
+    A = np.stack(rows)
+    y = np.array(meas)
+    # the f32-identified constants and combined byte slope
+    tiny = 1e-12
+    launch = fitted.launch_us
+    lat = fitted.ici.latency_us
+    inv_bw0 = 1.0 / max(fitted.ici.bandwidth_GBps * 1e3, tiny)
+    inv_rbw0 = 1.0 / max(fitted.reduce_bw_GBps * 1e3, tiny)
+    p0 = points[0]
+    fv = feature_vector(p0.widths, p0.num_nodes, p0.nbytes)
+    if fv[3] <= tiny:
+        return skipped("reduce feature empty")
+    c = float(fv[2] / fv[3])
+    q = c * inv_bw0 + inv_rbw0
+    rhs = y - launch * A[:, 0] - lat * A[:, 1] - q * A[:, 3]
+    M = np.stack([A[:, 2] - c * A[:, 3], A[:, 4]], axis=1)
+    w = 1.0 / np.maximum(y, 1e-9)
+    Mw, rhsw = M * w[:, None], rhs * w
+    sv = np.linalg.svd(Mw, compute_uv=False)
+    if sv.size < 2 or sv[1] < sv[0] * 1e-8:
+        return skipped(
+            "degenerate compressed set (wire-saving and codec columns "
+            "collinear; add shapes/sizes)"
+        )
+    (inv_bw, inv_cod), *_ = np.linalg.lstsq(Mw, rhsw, rcond=None)
+    hi = q / c if c > tiny else float("inf")
+    if not (0.0 <= inv_bw <= hi):
+        # clamp the wire split and re-solve the codec inverse alone
+        inv_bw = float(np.clip(inv_bw, 0.0, hi))
+        col = Mw[:, 1]
+        denom = float(col @ col)
+        inv_cod = (
+            float(col @ (rhsw - inv_bw * Mw[:, 0])) / denom
+            if denom > tiny
+            else 0.0
+        )
+    if not np.isfinite(inv_cod) or inv_cod <= tiny:
+        return skipped("non-positive codec excess")
+    inv_rbw = max(q - c * inv_bw, tiny)
+    bw = 1.0 / max(inv_bw, tiny) / 1e3
+    fitted = dataclasses.replace(
+        fitted,
+        ici=LinkParams(bandwidth_GBps=bw, latency_us=fitted.ici.latency_us),
+        dcn=LinkParams(bandwidth_GBps=bw, latency_us=fitted.dcn.latency_us),
+        reduce_bw_GBps=1.0 / inv_rbw / 1e3,
+        codec_bw_GBps=1.0 / inv_cod / 1e3,
+    )
+    return fitted, {
+        "codec_samples": len(rows),
+        "codec_bw_GBps": round(fitted.codec_bw_GBps, 3),
+        "wire_bw_GBps": round(fitted.ici.bandwidth_GBps, 3),
+    }
+
+
+# ------------------------------------------------------------------- drift
+
+
+class DriftDetector:
+    """Per-key sliding windows of relative residuals |pred-meas|/meas.
+
+    Key: (fingerprint, world, topo family, codec, sharded) — the grouping
+    the ISSUE names.  A key *breaches* when its window holds at least
+    ``min_window`` samples and their median exceeds ``band``.  The median
+    (not the mean, not the last sample) so one contention-spiked probe on
+    a timeshared host cannot trigger a replan storm; ``reset()`` after a
+    refit so residuals are re-judged against the NEW constants."""
+
+    def __init__(
+        self, band: float = 0.5, window: int = 16, min_window: int = 4
+    ):
+        if band <= 0:
+            raise ValueError(f"band must be > 0, got {band}")
+        if min_window < 1 or window < min_window:
+            raise ValueError(
+                f"need window >= min_window >= 1, got {window}/{min_window}"
+            )
+        self.band = float(band)
+        self.window = int(window)
+        self.min_window = int(min_window)
+        self._windows: dict[tuple, deque] = {}
+
+    def key(self, sample: ResidualSample) -> tuple:
+        return (
+            sample.fingerprint,
+            sample.world,
+            sample_family(sample),
+            sample.codec,
+            sample.sharded,
+        )
+
+    def observe(self, sample: ResidualSample) -> None:
+        self._windows.setdefault(
+            self.key(sample), deque(maxlen=self.window)
+        ).append(sample.rel_residual)
+
+    def breaches(self) -> dict[tuple, float]:
+        """{key: median rel residual} for every key past the band."""
+        out = {}
+        for key, win in self._windows.items():
+            if len(win) < self.min_window:
+                continue
+            med = float(np.median(list(win)))
+            if med > self.band:
+                out[key] = med
+        return out
+
+    @property
+    def drifted(self) -> bool:
+        return bool(self.breaches())
+
+    def reset(self) -> None:
+        self._windows.clear()
+
+
+def cache_invalidation_predicate(
+    fingerprint: str | None, world: int | None = None
+) -> Callable[[str, dict], bool]:
+    """The standard drift predicate for ``autotune.invalidate_plan_cache``:
+    match entries measured under ``fingerprint`` (the stored entry field —
+    the key string embeds the fingerprint but ``|``-splitting it is
+    ambiguous because fingerprints contain ``|``), optionally narrowed to
+    one world size via the key's ``n{world}`` component.  The world check
+    strips the fingerprint prefix first: the fingerprint itself carries an
+    ``n{device_count}`` part, and a bare substring match would make
+    ``world == device_count`` (the common case) match EVERY same-host key."""
+
+    def predicate(key: str, entry: dict) -> bool:
+        if entry.get("fingerprint") != fingerprint:
+            return False
+        if world is None:
+            return True
+        rest = key
+        # a None fingerprint serializes as plan_cache_key's "~" sentinel
+        prefix = "~" if fingerprint is None else fingerprint
+        if key.startswith(prefix + "|"):
+            rest = key[len(prefix) + 1 :]
+        return rest.startswith(f"n{world}|")
+
+    return predicate
+
+
+# -------------------------------------------------------------- controller
+
+
+@dataclass(frozen=True)
+class ProbePoint:
+    """One feedback probe: time the collective at (spec, nbytes, codec)."""
+
+    spec: str
+    nbytes: int
+    codec: str = "f32"
+
+
+def default_probe_points(n: int, nbytes: int) -> tuple[ProbePoint, ...]:
+    """A small well-conditioned probe set for world ``n``: the flat tree,
+    the first multi-stage factorization (when one exists), and the ring,
+    each at two payload sizes — 4-6 distinct points covering the launch /
+    latency / bandwidth axes, so two ticks clear the default
+    ``min_samples`` without ever measuring one shape alone."""
+    from .factorize import ordered_factorizations
+
+    specs = [str(n)]
+    for widths in ordered_factorizations(n):
+        if len(widths) >= 2:
+            specs.append(",".join(map(str, widths)))
+            break
+    if n >= 2:
+        specs.append("ring")
+    big = max(min(int(nbytes), 4 << 20), 1 << 15)
+    small = max(big // 8, 1 << 14)
+    sizes = [big] if small >= big else [big, small]
+    return tuple(ProbePoint(s, nb) for s in specs for nb in sizes)
+
+
+@dataclass
+class FeedbackConfig:
+    """Knobs for the in-run feedback loop (:class:`FeedbackController`).
+
+    ``every_k``: tick cadence in steps.  ``band``/``window``/
+    ``min_window``: the drift detector's parameters — breach = replan
+    trigger.  ``min_samples``: the fitter's starvation floor.
+    ``probes``: explicit :class:`ProbePoint` set (None derives
+    :func:`default_probe_points`).  ``repeat``: timed reps per probe per
+    tick (shuffled-interleaved, the harness protocol).
+    ``calibration_path``: where refits are written back
+    (``save_calibration(source="feedback")``); None skips persistence.
+    ``plan_cache_path``: the autotune cache to drift-invalidate (None =
+    the ambient ``FLEXTREE_PLAN_CACHE``/default).  ``on_replan(plan,
+    params)``: rebuild hook — return None to keep the current step, or
+    the same 3-/5-tuple ``Supervision.on_shrink`` returns; ``fit`` swaps
+    the step through the identical path.  ``max_refits`` bounds how many
+    times one run may refit (a loop that refits every tick is chasing
+    noise, not drift).  ``max_samples`` bounds the controller's residual
+    buffer to the most RECENT measurements — a refit must solve from the
+    regime that breached the band, not a run-long mix the old regime
+    dominates, and a healthy run must not grow the buffer forever.
+    ``run_id`` stamps the calibration provenance.
+    """
+
+    every_k: int = 50
+    band: float = 0.5
+    window: int = 16
+    min_window: int = 4
+    min_samples: int = 8
+    max_samples: int = 64
+    probes: tuple = ()
+    repeat: int = 3
+    calibration_path: str | None = None
+    backend: str | None = None
+    plan_cache_path: str | None = None
+    on_replan: Callable | None = None
+    max_refits: int = 4
+    run_id: str | None = None
+
+
+@dataclass
+class ReplanDecision:
+    """What one drift-triggered refit did — ``fit`` records it and applies
+    ``rebuilt`` through the shrink-path swap."""
+
+    plan: Any  # planner.choose.Plan under the refitted constants
+    params: TpuCostParams
+    drift: dict  # breached detector keys -> median rel residual
+    invalidated: int  # plan-cache entries dropped
+    fit_meta: dict
+    rebuilt: Any = None  # on_replan's 3-/5-tuple, or None
+
+
+class FeedbackController:
+    """The in-run half of the loop: probe, detect, refit, replan.
+
+    ``n``/``nbytes``: the sync world size and gradient-bytes hint the
+    replan prices (the same pair ``replan_for_survivors`` takes).
+    ``params``: the constants the RUNNING plan was priced with (defaults
+    to ``default_params()`` — i.e. whatever calibration the run started
+    from); residuals are judged against these until a refit replaces
+    them.  ``timer(probes, n) -> [seconds]`` and ``clock`` are
+    injectable for tests; the default timer runs each probe's collective
+    on the live backend with the bench harness's shuffled-interleaved
+    protocol, compiling once per probe point and caching the jitted fn
+    across ticks.
+
+    :meth:`maybe_tick` is the ``fit`` hook.  Its recorder-off cost is
+    ONE ``current_recorder() is None`` check — the exact check
+    ``record_event`` makes — so un-instrumented runs pay nothing
+    (machine-checked by ``tools/feedback_convergence.py``).
+    """
+
+    def __init__(
+        self,
+        n: int,
+        nbytes: int,
+        cfg: FeedbackConfig | None = None,
+        *,
+        params: TpuCostParams | None = None,
+        timer: Callable | None = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        self.n = int(n)
+        self.nbytes = int(nbytes)
+        self.cfg = cfg or FeedbackConfig()
+        self.params = params if params is not None else default_params()
+        self._timer = timer
+        self._clock = clock
+        self._fingerprint = backend_fingerprint()
+        self._detector = DriftDetector(
+            self.cfg.band, self.cfg.window, self.cfg.min_window
+        )
+        # bounded to the recent regime: a drift refit fits from the
+        # measurements that breached the band, not run-long history
+        self.samples: deque[ResidualSample] = deque(
+            maxlen=max(self.cfg.max_samples, self.cfg.min_samples)
+        )
+        self._fns: dict = {}  # compiled probe cache: point -> (fn, args)
+        self._inputs: dict = {}  # device probe inputs, shared by (n, size)
+        self._last_step: int | None = None
+        self._budget_logged = False
+        self._starved_logged = False
+        self.ticks = 0
+        self.refits = 0
+        self.refusals = 0
+
+    # -- resolution helpers --------------------------------------------
+
+    @property
+    def probes(self) -> tuple[ProbePoint, ...]:
+        return tuple(self.cfg.probes) or default_probe_points(
+            self.n, self.nbytes
+        )
+
+    def _backend_name(self) -> str:
+        if self.cfg.backend:
+            return self.cfg.backend
+        try:
+            import jax
+
+            return jax.default_backend()
+        except Exception:  # noqa: BLE001 — persistence must not need a backend
+            return "cpu"
+
+    # -- the fit hook ---------------------------------------------------
+
+    def maybe_tick(self, step: int) -> ReplanDecision | None:
+        """The per-step hook ``fit`` calls.  Recorder off -> one ``None``
+        check and out (zero overhead); otherwise tick on the ``every_k``
+        cadence."""
+        if current_recorder() is None:
+            return None
+        if self.refits >= self.cfg.max_refits:
+            # the refit budget is spent: no tick can ever refit or replan
+            # again, so stop paying probe wall-time for the rest of the
+            # run (warn once, not per cadence tick)
+            if not self._budget_logged:
+                self._budget_logged = True
+                self._fns.clear()  # compiled probes + device inputs: dead
+                self._inputs.clear()
+                log.warning(
+                    "feedback refit budget (%d) exhausted; probing "
+                    "disabled for the rest of the run",
+                    self.cfg.max_refits,
+                )
+            return None
+        k = max(1, self.cfg.every_k)
+        if step == 0 or step % k != 0 or step == self._last_step:
+            return None
+        self._last_step = step
+        return self.tick(step)
+
+    def tick(self, step: int) -> ReplanDecision | None:
+        """One feedback round: probe, record, detect; refit + replan on a
+        band breach.  Returns the :class:`ReplanDecision` when drift
+        fired (even if ``on_replan`` declined a rebuild), else None."""
+        self.ticks += 1
+        probes = self.probes
+        t0 = self._clock()
+        secs = (self._timer or self._default_timer)(probes, self.n)
+        if len(secs) != len(probes):
+            raise ValueError(
+                f"probe timer returned {len(secs)} times for "
+                f"{len(probes)} probes"
+            )
+        for p, s in zip(probes, secs):
+            measured_us = float(s) * 1e6
+            predicted = predict_spec_us(
+                p.spec, self.n, p.nbytes, self.params, codec=p.codec
+            )
+            if predicted is None:
+                continue
+            record_event(
+                "bucket_measured",
+                name=f"ftfb_probe_{p.spec.replace(',', 'x')}_{p.nbytes}B",
+                axis="ftfb",
+                topo={"ftfb": p.spec},
+                world={"ftfb": self.n},
+                nbytes=int(p.nbytes),
+                codec=p.codec,
+                sharded=False,
+                measured_us=round(measured_us, 3),
+                predicted_us=round(predicted, 3),
+                fingerprint=self._fingerprint,
+                step=int(step),
+            )
+            sample = ResidualSample(
+                topo="ring" if p.spec in ("1", "ring") else p.spec,
+                world=self.n,
+                codec=p.codec,
+                sharded=False,
+                nbytes=int(p.nbytes),
+                predicted_us=predicted,
+                measured_us=measured_us,
+                fingerprint=self._fingerprint,
+                step=int(step),
+                source="self",
+            )
+            self.samples.append(sample)
+            self._detector.observe(sample)
+        record_event(
+            "feedback_tick",
+            step=int(step),
+            probes=len(probes),
+            elapsed_ms=round((self._clock() - t0) * 1e3, 3),
+        )
+        breaches = self._detector.breaches()
+        if not breaches:
+            return None
+        if len(samples_to_points(self.samples)) < self.cfg.min_samples:
+            # the band can breach on the very first tick (a grossly
+            # mis-calibrated start) before enough points exist to fit —
+            # keep accumulating rather than burn a loud refusal on warm-up.
+            # Count ELIGIBLE points (the fitter's own currency), not raw
+            # samples: a probe set mixing codecs under a tight max_samples
+            # would otherwise pass this gate while the fit can never see
+            # min_samples f32 points — a refuse-every-tick livelock
+            if (
+                len(self.samples) == self.samples.maxlen
+                and not self._starved_logged
+            ):
+                # the buffer is FULL and still short of eligible points:
+                # accumulation can never get there — say so once instead
+                # of warming up silently forever
+                self._starved_logged = True
+                log.warning(
+                    "feedback sample buffer full (%d) with fewer than "
+                    "min_samples=%d eligible f32 points; this probe set "
+                    "cannot feed a refit — widen max_samples or add "
+                    "identity-codec probes", len(self.samples),
+                    self.cfg.min_samples,
+                )
+            return None
+        if self.refits >= self.cfg.max_refits:
+            log.warning(
+                "feedback drift persists after %d refit(s); refit budget "
+                "exhausted — holding the current plan", self.refits,
+            )
+            return None
+        return self._refit_and_replan(step, breaches)
+
+    def _refit_and_replan(self, step: int, breaches: dict) -> ReplanDecision | None:
+        drift = {
+            "|".join(str(p) for p in key): round(med, 4)
+            for key, med in breaches.items()
+        }
+        try:
+            new_params, meta = fit_from_samples(
+                self.samples,
+                base_params=self.params,
+                min_samples=self.cfg.min_samples,
+            )
+        except FeedbackRefused as e:
+            self.refusals += 1
+            record_event(
+                "feedback_refused", step=int(step), reason=str(e)[:300]
+            )
+            log.warning("feedback refit refused at step %d: %s", step, e)
+            return None
+        self.refits += 1
+        if self.cfg.calibration_path:
+            save_calibration(
+                self.cfg.calibration_path,
+                new_params,
+                backend=self._backend_name(),
+                fingerprint=self._fingerprint,
+                source="feedback",
+                meta={
+                    "samples": len(self.samples),
+                    "run_id": self.cfg.run_id or f"step{step}",
+                    "step": int(step),
+                    "fit": meta,
+                    "drift": drift,
+                },
+            )
+        removed = invalidate_plan_cache(
+            # world=None: the refit replaced the CONSTANTS, which priced
+            # every shortlist this backend ever measured — a multi-axis
+            # run's other sync worlds (tp beside dp) are exactly as stale
+            # as the probed axis, and a surviving entry would cache-hit
+            # the rebuilt step straight back onto the stale winner
+            cache_invalidation_predicate(self._fingerprint, None),
+            cache_path=self.cfg.plan_cache_path,
+        )
+        plan = choose_topology(self.n, self.nbytes, params=new_params)
+        self.params = new_params
+        self._detector.reset()  # re-judge residuals against the refit
+        record_event(
+            "feedback_refit",
+            step=int(step),
+            topo=plan.to_ft_topo(),
+            invalidated=removed,
+            drift=drift,
+            samples=len(self.samples),
+        )
+        log.warning(
+            "feedback refit at step %d: drift %s; replanned topo %s, "
+            "%d plan-cache entr%s invalidated",
+            step, drift, plan.to_ft_topo(), removed,
+            "y" if removed == 1 else "ies",
+        )
+        # drop the consumed samples: a LATER refit (a genuine mid-run
+        # regime change) must solve from post-refit measurements, not a
+        # mix the old regime dominates; the warm-up guard in tick() makes
+        # the next breach re-accumulate min_samples before fitting
+        self.samples.clear()
+        rebuilt = (
+            self.cfg.on_replan(plan, new_params)
+            if self.cfg.on_replan is not None
+            else None
+        )
+        return ReplanDecision(plan, new_params, breaches, removed, meta, rebuilt)
+
+    # -- the default live-wire probe timer ------------------------------
+
+    def _default_timer(self, probes, n):
+        """Time each probe's collective on the live backend — the bench
+        harness's shuffled-interleaved protocol over jitted, warmed fns
+        (compiled once per probe point, cached across ticks)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..bench.harness import _interleaved_times
+        from ..parallel.compressed import compressed_allreduce
+        from ..parallel.mesh import flat_mesh
+
+        calls = {}
+        for i, p in enumerate(probes):
+            cached = self._fns.get(p)
+            if cached is None:
+                mesh = flat_mesh(n, "ftfb")
+                size = max(1, p.nbytes // 4)
+                # the input depends only on (n, size) — share one device
+                # array across the specs/codecs probing the same payload
+                # instead of pinning an identical copy per ProbePoint
+                x = self._inputs.get((n, size))
+                if x is None:
+                    rng = np.random.default_rng((n * 1000003 + size) & 0xFFFF)
+                    x = jnp.asarray(
+                        rng.standard_normal((n, size)).astype(np.float32)
+                    )
+                    self._inputs[(n, size)] = x
+                wire_spec = "1" if p.spec == "ring" else p.spec
+
+                def device_fn(row, spec=wire_spec, codec=p.codec):
+                    return compressed_allreduce(
+                        row[0], "ftfb", topo=spec, codec=codec
+                    )[None]
+
+                fn = jax.jit(
+                    jax.shard_map(
+                        device_fn, mesh=mesh, in_specs=P("ftfb"),
+                        out_specs=P("ftfb"), check_vma=False,
+                    )
+                )
+                jax.block_until_ready(fn(x))  # compile outside the timing
+                cached = (fn, (x,))
+                self._fns[p] = cached
+            calls[str(i)] = cached
+        rows = _interleaved_times(calls, max(1, self.cfg.repeat))
+        return [rows[str(i)]["min_ms"] * 1e-3 for i in range(len(probes))]

@@ -19,14 +19,20 @@ from __future__ import annotations
 
 import argparse
 import os
+import shutil
+import tempfile
 
 
-def build(args):
+def build(args, init_state=True):
     """(state, step_fn, mesh, restore_specs, state_pack, state_unpack)
     for the chosen model family.  ``restore_specs`` describes the
     CHECKPOINT layout (for sharded runs that is the consolidated
     replicated layout; ``state_pack``/``state_unpack`` convert — None for
-    replicated runs)."""
+    replicated runs).  ``init_state=False`` skips materializing the
+    train state (returns None in its slot) — the feedback replan rebuild
+    only needs the step fn, and initializing a second full model +
+    optimizer state beside the live one doubles peak memory at exactly
+    the replan moment."""
     import jax
 
     from .models.transformer import TransformerConfig
@@ -108,7 +114,7 @@ def build(args):
             mesh, param_specs(cfg, "tp"), params_shapes, axis_names, sspecs, tc
         )
         return (
-            init_train_state(key, cfg, tc, mesh=mesh),
+            init_train_state(key, cfg, tc, mesh=mesh) if init_state else None,
             make_train_step(mesh, cfg, tc),
             mesh,
             restore_specs,
@@ -145,7 +151,8 @@ def build(args):
             tc,
         )
         return (
-            init_pipeline_train_state(key, cfg, tc, mesh=mesh),
+            init_pipeline_train_state(key, cfg, tc, mesh=mesh)
+            if init_state else None,
             make_pipeline_train_step(
                 mesh, cfg, tc, n_microbatches=args.microbatches
             ),
@@ -184,7 +191,8 @@ def build(args):
             mesh, moe_param_specs(cfg), params_shapes, axis_names, sspecs, tc
         )
         return (
-            init_moe_train_state(key, cfg, tc, mesh=mesh),
+            init_moe_train_state(key, cfg, tc, mesh=mesh)
+            if init_state else None,
             make_moe_train_step(mesh, cfg, tc),
             mesh,
             restore_specs,
@@ -302,6 +310,32 @@ def main(argv=None) -> int:
         help="disable the SIGTERM 'checkpoint now' fast path (on by "
         "default whenever --ckpt-dir is set)",
     )
+    # closed-loop planner feedback (planner/feedback.py; docs/FEEDBACK.md)
+    ap.add_argument(
+        "--feedback-every", type=int, default=0, metavar="K",
+        help="arm the closed-loop planner feedback: every K steps (with "
+        "the flight recorder on — pair with --obs-dir/--flight-recorder) "
+        "probe the live wire, compare measured comm time against the "
+        "calibrated prediction, and past the drift band refit the cost "
+        "constants, invalidate stale plan-cache entries and swap in a "
+        "replanned step in-run. 0 (default) = off; with the recorder off "
+        "the armed hook costs one None check per step",
+    )
+    ap.add_argument(
+        "--feedback-band", type=float, default=0.5, metavar="R",
+        help="relative-residual drift band for --feedback-every: a replan "
+        "triggers when the median |predicted-measured|/measured over the "
+        "sliding window exceeds R",
+    )
+    ap.add_argument(
+        "--feedback-calibration", type=str, default=None, metavar="PATH",
+        help="write feedback refits back to this CALIBRATION.json "
+        "(source=\"feedback\" provenance stamp); defaults to a run-local "
+        "CALIBRATION.feedback.json under --obs-dir, seeded as a copy of "
+        "$FLEXTREE_CALIBRATION when that is set — the user's measured "
+        "file is never overwritten by an in-run fit (the replan rebuild "
+        "reads the refit from this file)",
+    )
     # telemetry (flextree_tpu.obs; docs/OBSERVABILITY.md)
     ap.add_argument(
         "--obs-dir", type=str, default=None, metavar="DIR",
@@ -363,6 +397,7 @@ def main(argv=None) -> int:
     import contextlib
 
     obs_ctx = contextlib.nullcontext()
+    obs_dir = None
     if args.obs_dir or args.flight_recorder:
         from .obs import flight_recorder, install_signal_dump
 
@@ -380,6 +415,82 @@ def main(argv=None) -> int:
             # terminate leaves the forensic record
             install_signal_dump(obs_rec)
         state, step_fn, mesh, sspecs, state_pack, state_unpack = build(args)
+        if args.feedback_every > 0:
+            # closed-loop planner feedback (docs/FEEDBACK.md): probes ride
+            # the largest mesh axis (the dominant sync wire); a drift-
+            # triggered replan rebuilds the step so the refreshed
+            # calibration re-derives bucket sizes/topology at trace time
+            import jax
+
+            from .planner.feedback import FeedbackConfig, FeedbackController
+
+            param_bytes = sum(
+                l.size * l.dtype.itemsize
+                for l in jax.tree.leaves(state["params"])
+            )
+            n_fb = max((int(s) for s in mesh.shape.values()), default=1)
+            # the refit must land somewhere build() can SEE: the rebuild
+            # below re-derives bucket sizes/topology through the planner,
+            # which resolves constants from $FLEXTREE_CALIBRATION — so
+            # default the write-back path to a run-local file rather than
+            # leaving the loop open (refit written nowhere the rebuilt
+            # step reads)
+            fb_prev_cal = os.environ.get("FLEXTREE_CALIBRATION")
+            fb_cal = args.feedback_calibration
+            if not fb_cal:
+                # the same derived record dir the flight recorder uses
+                # (the controller only ever ticks with the recorder on,
+                # so a recorder-less run writes nothing anywhere —
+                # don't allocate a throwaway dir for it).  NEVER default
+                # to $FLEXTREE_CALIBRATION itself: a drift refit calls
+                # save_calibration, which replaces the backend's section
+                # in place — a noisy in-run fit must not destroy the
+                # host's measured tools/calibrate_host.py artifact.
+                # Seeding the run-local file from it keeps the other
+                # backends' sections and the measured provenance intact.
+                # no obs dir: a PER-RUN private dir, never a fixed name
+                # in the world-shared tempdir (a foreign-owned or
+                # pre-planted file at a fixed /tmp path would abort the
+                # copy below or redirect it through a symlink)
+                fb_cal = os.path.join(
+                    obs_dir
+                    if obs_dir is not None
+                    else tempfile.mkdtemp(prefix="ft-feedback-"),
+                    "CALIBRATION.feedback.json",
+                )
+                if fb_prev_cal and os.path.exists(fb_prev_cal):
+                    shutil.copyfile(fb_prev_cal, fb_cal)
+
+            def _feedback_rebuild(plan, params):
+                # rebuild with the refitted constants: point the planner
+                # at the calibration the controller just wrote back (the
+                # live state stays — only the fn/mesh/specs swap, so the
+                # rebuild skips materializing a second train state).
+                # The env var must STAY pointed at the refit for the rest
+                # of the run: build() only constructs the jitted fn — the
+                # swapped step first TRACES on the next fit iteration,
+                # where plan_buckets resolves $FLEXTREE_CALIBRATION to
+                # derive bucket sizes.  Restoring here would hand that
+                # trace the stale constants and silently re-open the
+                # loop's bucket half (the fit-end finally below restores
+                # the original value for in-process callers).
+                os.environ["FLEXTREE_CALIBRATION"] = fb_cal
+                _none, f2, m2, sp2, pk2, up2 = build(args, init_state=False)
+                return (f2, m2, sp2, pk2, up2)
+
+            controller = FeedbackController(
+                n_fb,
+                param_bytes,
+                FeedbackConfig(
+                    every_k=args.feedback_every,
+                    band=args.feedback_band,
+                    calibration_path=fb_cal,
+                    on_replan=_feedback_rebuild,
+                ),
+            )
+            if supervision is None:
+                supervision = Supervision()
+            supervision.feedback = controller
         dataset = LMDataset(
             synthetic_tokens(args.corpus_tokens, args.vocab, seed=args.seed),
             batch=args.batch,
@@ -407,6 +518,15 @@ def main(argv=None) -> int:
         finally:
             if supervision is not None and supervision.preemption is not None:
                 supervision.preemption.uninstall()  # in-process callers (tests)
+            if args.feedback_every > 0:
+                # a replan rebuild repoints $FLEXTREE_CALIBRATION at the
+                # refit file for the rest of the run (the swapped step
+                # traces lazily); restore the pre-run value so in-process
+                # callers (tests) aren't left with a run-local path
+                if fb_prev_cal is None:
+                    os.environ.pop("FLEXTREE_CALIBRATION", None)
+                else:
+                    os.environ["FLEXTREE_CALIBRATION"] = fb_prev_cal
     first = result.losses[0][1] if result.losses else float("nan")
     last = result.losses[-1][1] if result.losses else float("nan")
     print(

@@ -1,0 +1,1040 @@
+"""Closed-loop planner feedback (ISSUE 12): residual extraction, fit
+guards, drift detection, cache invalidation, and the in-run replan hook.
+
+Everything here runs with injectable probe timers and clocks — no live
+collectives are timed, so the tests are deterministic; the live-wire
+half of the loop is proven by ``tools/feedback_convergence.py`` →
+FEEDBACK.json (the ``feedback-smoke`` CI job).
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from flextree_tpu.obs import flight_recorder
+from flextree_tpu.obs.timeline import (
+    ResidualSample,
+    residual_pairs,
+    residual_table,
+)
+from flextree_tpu.planner import LinkParams, TpuCostParams
+from flextree_tpu.planner import feedback as fb
+from flextree_tpu.planner.autotune import (
+    PLAN_CACHE_SCHEMA,
+    autotune_plan,
+    invalidate_plan_cache,
+)
+from flextree_tpu.planner.feedback import (
+    DriftDetector,
+    FeedbackConfig,
+    FeedbackController,
+    FeedbackRefused,
+    ProbePoint,
+    cache_invalidation_predicate,
+    default_probe_points,
+    fit_from_samples,
+    predict_spec_us,
+    sample_family,
+    samples_to_points,
+)
+
+TRUE = TpuCostParams(
+    ici=LinkParams(bandwidth_GBps=2.0, latency_us=50.0),
+    dcn=LinkParams(bandwidth_GBps=2.0, latency_us=50.0),
+    reduce_bw_GBps=8.0,
+    control_us_per_width=0.0,
+    launch_us=400.0,
+)
+SKEW = TpuCostParams(
+    ici=LinkParams(bandwidth_GBps=100.0, latency_us=0.001),
+    dcn=LinkParams(bandwidth_GBps=100.0, latency_us=0.001),
+    reduce_bw_GBps=1000.0,
+    control_us_per_width=0.0,
+    launch_us=0.001,
+)
+
+
+def planned_ev(spec, nbytes, pred, *, world=8, codec="f32", **extra):
+    return {
+        "ts": 1.0, "rank": 0, "seq": 0, "kind": "bucket_planned",
+        "topo": {"dp": spec}, "world": {"dp": world}, "nbytes": nbytes,
+        "codec": codec, "sharded": False, "predicted_us": pred, **extra,
+    }
+
+
+def measured_ev(spec, nbytes, meas, *, world=8, codec="f32", pred=None,
+                step=1, fingerprint="fp"):
+    ev = {
+        "ts": 2.0, "rank": 0, "seq": 1, "kind": "bucket_measured",
+        "topo": {"ftfb": spec}, "world": {"ftfb": world}, "nbytes": nbytes,
+        "codec": codec, "sharded": False, "measured_us": meas, "step": step,
+        "fingerprint": fingerprint,
+    }
+    if pred is not None:
+        ev["predicted_us"] = pred
+    return ev
+
+
+def synthetic_samples(params=TRUE, shapes=("8", "4,2", "2,2,2", "ring"),
+                      sizes=(1 << 16, 1 << 20), reps=2, n=8, noise=None):
+    """Samples whose measured side is model-generated from ``params``."""
+    out = []
+    rng = np.random.default_rng(0)
+    for spec in shapes:
+        for nb in sizes:
+            true_us = predict_spec_us(spec, n, nb, params)
+            for _ in range(reps):
+                meas = true_us * (
+                    float(rng.uniform(*noise)) if noise else 1.0
+                )
+                out.append(
+                    ResidualSample(
+                        topo=spec, world=n, codec="f32", sharded=False,
+                        nbytes=nb, predicted_us=true_us * 0.01,
+                        measured_us=meas, fingerprint="fp", source="self",
+                    )
+                )
+    return out
+
+
+# ------------------------------------------------------------ extraction
+
+
+class TestResidualPairing:
+    def test_measured_pairs_with_planned_prediction(self):
+        events = [
+            planned_ev("4,2", 1024, 100.0),
+            measured_ev("4,2", 1024, 250.0, pred=90.0),
+        ]
+        samples, skipped = residual_pairs(events)
+        assert len(samples) == 1
+        s = samples[0]
+        # the PLANNED span's prediction wins over the probe's own
+        assert s.predicted_us == 100.0
+        assert s.measured_us == 250.0
+        assert s.source == "paired"
+        assert s.topo == "4,2" and s.world == 8
+        assert s.rel_residual == pytest.approx(150.0 / 250.0)
+        assert skipped["unmeasured_plans"] == 0
+
+    def test_unpaired_measured_falls_back_to_self_prediction(self):
+        samples, skipped = residual_pairs(
+            [measured_ev("8", 2048, 500.0, pred=50.0)]
+        )
+        assert len(samples) == 1
+        assert samples[0].source == "self"
+        assert samples[0].predicted_us == 50.0
+
+    def test_measured_without_any_prediction_is_skipped(self):
+        samples, skipped = residual_pairs([measured_ev("8", 2048, 500.0)])
+        assert samples == []
+        assert skipped["unpredicted"] == 1
+
+    def test_unmeasured_plans_are_counted_not_paired(self):
+        samples, skipped = residual_pairs([planned_ev("2,2,2", 4096, 10.0)])
+        assert samples == []
+        assert skipped["unmeasured_plans"] == 1
+
+    def test_ring_spec_normalization(self):
+        # provenance labels the ring "ring"; the wire grammar's sentinel
+        # is "1" — the pairing must treat them as one point
+        events = [
+            planned_ev("ring", 1024, 70.0),
+            measured_ev("1", 1024, 140.0),
+        ]
+        samples, _ = residual_pairs(events)
+        assert len(samples) == 1
+        assert samples[0].topo == "ring"
+        assert samples[0].predicted_us == 70.0
+
+    def test_predicted_error_span_skipped_not_crashed(self):
+        # obs/provenance.py's raising-cost-model path leaves a span with
+        # predicted_error=True and NO predicted fields — the extractor
+        # must skip it (counted), never crash on it
+        broken = {
+            "ts": 1.0, "rank": 0, "seq": 0, "kind": "bucket_planned",
+            "topo": {"dp": "4,2"}, "nbytes": 1024, "codec": "f32",
+            "sharded": False, "predicted_error": True,
+        }
+        events = [broken, measured_ev("4,2", 1024, 250.0, pred=90.0)]
+        samples, skipped = residual_pairs(events)
+        assert skipped["predicted_error"] == 1
+        assert len(samples) == 1  # the probe's own prediction still pairs
+        assert samples[0].source == "self"
+
+    def test_mismatched_nbytes_do_not_pair(self):
+        events = [
+            planned_ev("8", 1024, 100.0),
+            measured_ev("8", 2048, 300.0, pred=40.0),
+        ]
+        samples, skipped = residual_pairs(events)
+        assert samples[0].source == "self"  # different point: no alias
+        assert skipped["unmeasured_plans"] == 1
+
+    def test_table_renders_groups(self):
+        samples, skipped = residual_pairs(
+            [
+                planned_ev("4,2", 1024, 100.0),
+                measured_ev("4,2", 1024, 250.0),
+                measured_ev("8", 1024, 80.0, pred=75.0),
+            ]
+        )
+        table = residual_table(samples, skipped)
+        assert "4,2" in table and "topo" in table
+        assert "n8" in table
+
+    def test_extract_residuals_reads_flight_files(self, tmp_path):
+        with flight_recorder(tmp_path, 0) as rec:
+            rec.record("bucket_planned", **{
+                k: v for k, v in planned_ev("8", 512, 33.0).items()
+                if k not in ("ts", "rank", "seq", "kind")
+            })
+            rec.record("bucket_measured", **{
+                k: v for k, v in measured_ev("8", 512, 99.0).items()
+                if k not in ("ts", "rank", "seq", "kind")
+            })
+        samples, _ = fb.extract_residuals(str(tmp_path))
+        assert len(samples) == 1
+        assert samples[0].predicted_us == 33.0
+        assert samples[0].measured_us == 99.0
+
+
+def test_provenance_predicted_error_does_not_kill_the_step(monkeypatch):
+    """A raising cost model must leave predicted_error=True on the span,
+    never an exception into the traced step (obs/provenance.py)."""
+    from flextree_tpu.obs import bucket_provenance
+    from flextree_tpu.planner import cost_model
+    from flextree_tpu.schedule.stages import Topology
+
+    def boom(*a, **k):
+        raise RuntimeError("cost model exploded")
+
+    monkeypatch.setattr(cost_model, "allreduce_cost", boom)
+    with flight_recorder(None, 0):
+        prov = bucket_provenance(
+            ("dp",), {"dp": Topology.flat(8)}, 4096, n_leaves=3
+        )
+    assert prov is not None
+    assert prov["predicted_error"] is True
+    assert "predicted_us" not in prov
+    assert prov["world"] == {"dp": 8}
+
+
+def test_provenance_carries_world(monkeypatch):
+    from flextree_tpu.obs import bucket_provenance
+    from flextree_tpu.schedule.stages import Topology
+
+    with flight_recorder(None, 0):
+        prov = bucket_provenance(
+            ("dp", "tp"), {"dp": Topology.flat(8), "tp": None}, 1 << 20
+        )
+    assert prov["world"] == {"dp": 8, "tp": None}
+    assert prov["topo"] == {"dp": "8", "tp": "psum"}
+
+
+# ------------------------------------------------------------------ fitting
+
+
+class TestFitGuards:
+    def test_refuses_starved_few_samples(self):
+        samples = synthetic_samples(shapes=("8",), sizes=(1 << 16,), reps=3)
+        with pytest.raises(FeedbackRefused, match="starved"):
+            fit_from_samples(samples, min_samples=8)
+
+    def test_refuses_starved_few_distinct_points(self):
+        # plenty of samples, ONE point: re-measuring it cannot pin 4
+        # constants
+        samples = synthetic_samples(shapes=("8",), sizes=(1 << 16,), reps=20)
+        with pytest.raises(FeedbackRefused, match="distinct"):
+            fit_from_samples(samples, min_samples=8)
+
+    def test_refuses_degenerate_geometry(self):
+        # one shape across sizes: >= min_distinct points but the feature
+        # matrix spans only the fixed + byte directions (rank 2 < 3)
+        samples = synthetic_samples(
+            shapes=("8",),
+            sizes=(1 << 14, 1 << 16, 1 << 18, 1 << 20),
+            reps=3,
+        )
+        with pytest.raises(FeedbackRefused, match="feature directions"):
+            fit_from_samples(samples, min_samples=8)
+
+    def test_filters_feed_only_eligible_samples(self):
+        eligible = synthetic_samples()
+        noise = [
+            ResidualSample("4,2", 8, "int8", False, 1024, 10.0, 20.0),
+            ResidualSample("4,2", 8, "f32", True, 1024, 10.0, 20.0),
+            ResidualSample("3,2+2", 8, "f32", False, 1024, 10.0, 20.0),
+            ResidualSample("psum", None, "f32", False, 1024, 10.0, 20.0),
+            ResidualSample("8", None, "f32", False, 1024, 10.0, 20.0),
+        ]
+        pts = samples_to_points(eligible + noise)
+        assert len(pts) == len(eligible)
+
+    def test_fit_recovers_generating_constants(self):
+        samples = synthetic_samples(params=TRUE)
+        fitted, meta = fit_from_samples(samples, min_samples=8)
+        for spec in ("8", "4,2", "2,2,2", "ring"):
+            for nb in (1 << 16, 1 << 20):
+                want = predict_spec_us(spec, 8, nb, TRUE)
+                got = predict_spec_us(spec, 8, nb, fitted)
+                assert got == pytest.approx(want, rel=0.05, abs=1.0)
+        assert meta["points"] == len(samples)
+        assert meta["distinct_points"] == 8
+
+    def test_fit_survives_noise(self):
+        samples = synthetic_samples(params=TRUE, noise=(0.85, 1.15))
+        fitted, _ = fit_from_samples(samples, min_samples=8)
+        from flextree_tpu.planner import spearman
+
+        specs = [("8", nb) for nb in (1 << 16, 1 << 20)] + [
+            ("4,2", nb) for nb in (1 << 16, 1 << 20)
+        ] + [("ring", nb) for nb in (1 << 16, 1 << 20)]
+        truth = [predict_spec_us(s, 8, nb, TRUE) for s, nb in specs]
+        pred = [predict_spec_us(s, 8, nb, fitted) for s, nb in specs]
+        assert spearman(pred, truth) >= 0.9
+
+    def test_codec_rescale_from_compressed_samples(self):
+        # measured int8 times generated with HALF the codec throughput:
+        # the refit must move codec_bw_GBps toward that value
+        slow_codec = TpuCostParams(
+            ici=TRUE.ici, dcn=TRUE.dcn, reduce_bw_GBps=TRUE.reduce_bw_GBps,
+            control_us_per_width=0.0, launch_us=TRUE.launch_us,
+            codec_bw_GBps=TpuCostParams.codec_bw_GBps / 2,
+        )
+        samples = synthetic_samples(params=TRUE)
+        for spec in ("8", "4,2", "ring"):
+            for nb in (1 << 16, 1 << 20):
+                meas = predict_spec_us(spec, 8, nb, slow_codec, codec="int8")
+                samples.append(
+                    ResidualSample(
+                        topo=spec, world=8, codec="int8", sharded=False,
+                        nbytes=nb, predicted_us=meas, measured_us=meas,
+                        source="self",
+                    )
+                )
+        fitted, meta = fit_from_samples(samples, min_samples=8)
+        assert meta["codec_samples"] == 6
+        assert fitted.codec_bw_GBps == pytest.approx(
+            slow_codec.codec_bw_GBps, rel=0.15
+        )
+
+    def test_codec_rescale_skipped_when_unattributable(self):
+        # measured compressed time BELOW the alpha-beta floor: the codec
+        # excess is negative — the memcpy-wire case; refit must skip the
+        # rescale and say so, not fit a nonsense throughput
+        samples = synthetic_samples(params=TRUE)
+        for nb in (1 << 16, 1 << 20):
+            floor = predict_spec_us("8", 8, nb, TRUE) * 0.5
+            samples.append(
+                ResidualSample(
+                    topo="8", world=8, codec="int8", sharded=False,
+                    nbytes=nb, predicted_us=floor, measured_us=floor,
+                    source="self",
+                )
+            )
+        fitted, meta = fit_from_samples(samples, min_samples=8)
+        assert "codec_refit" in meta and "skipped" in meta["codec_refit"]
+        assert fitted.codec_bw_GBps == TpuCostParams.codec_bw_GBps
+
+    def test_bwd_gflops_from_compute_samples(self):
+        fitted, meta = fit_from_samples(
+            synthetic_samples(),
+            min_samples=8,
+            compute_samples=[(2e9, 1.0), (4e9, 2.0), (1e9, 0.5)],
+        )
+        assert fitted.bwd_GFLOPs == pytest.approx(2.0)
+        assert meta["compute_samples"] == 3
+        assert fb.fit_bwd_gflops([(1e9, 1.0)]) is None  # < 2 samples
+        assert fb.fit_bwd_gflops([]) is None
+        # a generator must not be exhausted before the meta count
+        fitted, meta = fit_from_samples(
+            synthetic_samples(),
+            min_samples=8,
+            compute_samples=(s for s in [(2e9, 1.0), (4e9, 2.0)]),
+        )
+        assert fitted.bwd_GFLOPs == pytest.approx(2.0)
+        assert meta["compute_samples"] == 2
+
+
+# -------------------------------------------------------------------- drift
+
+
+class TestDriftDetector:
+    def sample(self, rel, *, topo="8", codec="f32"):
+        meas = 100.0
+        return ResidualSample(
+            topo=topo, world=8, codec=codec, sharded=False, nbytes=1024,
+            predicted_us=meas * (1 + rel), measured_us=meas,
+            fingerprint="fp", source="self",
+        )
+
+    def test_no_breach_below_band(self):
+        det = DriftDetector(band=0.5, window=8, min_window=2)
+        for _ in range(8):
+            det.observe(self.sample(0.2))
+        assert det.breaches() == {}
+        assert not det.drifted
+
+    def test_breach_needs_min_window(self):
+        det = DriftDetector(band=0.5, window=8, min_window=4)
+        for i in range(3):
+            det.observe(self.sample(2.0))
+        assert det.breaches() == {}
+        det.observe(self.sample(2.0))
+        assert list(det.breaches().values()) == [pytest.approx(2.0)]
+
+    def test_median_rides_out_one_spike(self):
+        det = DriftDetector(band=0.5, window=8, min_window=4)
+        for rel in (0.1, 0.1, 5.0, 0.1):
+            det.observe(self.sample(rel))
+        assert det.breaches() == {}
+
+    def test_keys_are_per_family_and_codec(self):
+        det = DriftDetector(band=0.5, window=8, min_window=1)
+        det.observe(self.sample(2.0, topo="8"))
+        det.observe(self.sample(0.1, topo="ring"))
+        det.observe(self.sample(2.0, codec="int8"))
+        keys = set(det.breaches())
+        assert ("fp", 8, "tree", "f32", False) in keys
+        assert ("fp", 8, "tree", "int8", False) in keys
+        assert ("fp", 8, "ring", "f32", False) not in keys
+
+    def test_reset_clears_windows(self):
+        det = DriftDetector(band=0.5, window=8, min_window=1)
+        det.observe(self.sample(2.0))
+        assert det.drifted
+        det.reset()
+        assert not det.drifted
+
+    def test_sample_family(self):
+        assert sample_family(self.sample(0, topo="8")) == "tree"
+        assert sample_family(self.sample(0, topo="4,2")) == "tree"
+        assert sample_family(self.sample(0, topo="ring")) == "ring"
+        assert sample_family(self.sample(0, topo="3,2+2")) == "lonely"
+        assert sample_family(self.sample(0, topo="psum")) == "psum"
+
+
+# -------------------------------------------------------- cache invalidation
+
+
+def fake_tuner_timer(times):
+    def timer(cands, n, nb, dt, rep):
+        return list(times[: len(cands)])
+
+    return timer
+
+
+class TestCacheInvalidation:
+    def test_predicate_matches_fingerprint_and_world(self):
+        pred = cache_invalidation_predicate("fpA", 8)
+        assert pred("fpA|n8|4096B|float32|f32|serial|replicated",
+                    {"fingerprint": "fpA"})
+        assert not pred("fpA|n4|4096B|float32|f32|serial|replicated",
+                        {"fingerprint": "fpA"})
+        assert not pred("fpB|n8|4096B|float32|f32|serial|replicated",
+                        {"fingerprint": "fpB"})
+        # no world filter: every entry of the fingerprint matches
+        pred_all = cache_invalidation_predicate("fpA")
+        assert pred_all("fpA|n4|4096B|float32|f32|serial|replicated",
+                        {"fingerprint": "fpA"})
+        # real fingerprints carry their own n{device_count} part — a
+        # world filter equal to the device count must not match every
+        # same-host key through the fingerprint prefix
+        fp = "cpu|cpu|n8|jax0.4.37"
+        pred8 = cache_invalidation_predicate(fp, 8)
+        assert pred8(f"{fp}|n8|4096B|float32|f32|serial|replicated",
+                     {"fingerprint": fp})
+        assert not pred8(f"{fp}|n4|4096B|float32|f32|serial|replicated",
+                         {"fingerprint": fp})
+
+    def test_invalidate_plan_cache_drops_only_matches(self, tmp_path):
+        path = tmp_path / "cache.json"
+        doc = {
+            "schema": PLAN_CACHE_SCHEMA,
+            "entries": {
+                "fpA|n8|1B|float32|f32|serial|replicated":
+                    {"fingerprint": "fpA"},
+                "fpA|n4|1B|float32|f32|serial|replicated":
+                    {"fingerprint": "fpA"},
+                "fpB|n8|1B|float32|f32|serial|replicated":
+                    {"fingerprint": "fpB"},
+            },
+        }
+        path.write_text(json.dumps(doc))
+        removed = invalidate_plan_cache(
+            cache_invalidation_predicate("fpA", 8), cache_path=str(path)
+        )
+        assert removed == 1
+        left = json.loads(path.read_text())["entries"]
+        assert set(left) == {
+            "fpA|n4|1B|float32|f32|serial|replicated",
+            "fpB|n8|1B|float32|f32|serial|replicated",
+        }
+
+    def test_plan_cache_schema_decoupled_from_calibration(self, tmp_path):
+        """The calibration schema-4 bump (provenance stamp) must not
+        orphan plan caches: the plan-cache file keeps its OWN schema, so
+        caches written by this version still load under a pre-stamp
+        checkout (whose loader discards schema > 3) and vice versa."""
+        from flextree_tpu.planner.calibrate import CALIBRATION_SCHEMA
+
+        assert PLAN_CACHE_SCHEMA < CALIBRATION_SCHEMA
+        path = str(tmp_path / "cache.json")
+        kw = dict(
+            codecs=("f32",), top_k=2, cache_path=path,
+            timer=fake_tuner_timer([0.002, 0.001]),
+        )
+        autotune_plan(8, 1 << 20, **kw)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["schema"] == PLAN_CACHE_SCHEMA
+        assert autotune_plan(8, 1 << 20, **kw).source == "cache"
+
+    def test_invalidate_missing_cache_is_noop(self, tmp_path):
+        assert invalidate_plan_cache(
+            lambda k, e: True, cache_path=str(tmp_path / "nope.json")
+        ) == 0
+
+    def test_cache_entry_no_alias_after_refit(self, tmp_path):
+        """Invalidation forces a RE-MEASURE (source='measured'), never a
+        stale hit; the re-measured entry then caches normally."""
+        from flextree_tpu.planner.calibrate import backend_fingerprint
+
+        path = str(tmp_path / "cache.json")
+        kw = dict(
+            codecs=("f32",), top_k=2, cache_path=path,
+            timer=fake_tuner_timer([0.002, 0.001]),
+        )
+        first = autotune_plan(8, 1 << 20, **kw)
+        assert first.source == "measured"
+        hit = autotune_plan(8, 1 << 20, **kw)
+        assert hit.source == "cache"
+        removed = invalidate_plan_cache(
+            cache_invalidation_predicate(backend_fingerprint(), 8),
+            cache_path=path,
+        )
+        assert removed == 1
+        # different-world entries must NOT alias the invalidated key
+        other = autotune_plan(4, 1 << 20, **kw)
+        assert other.source == "measured"
+        remeasured = autotune_plan(8, 1 << 20, **kw)
+        assert remeasured.source == "measured"  # re-measured, not stale
+        assert autotune_plan(8, 1 << 20, **kw).source == "cache"
+        assert autotune_plan(4, 1 << 20, **kw).source == "cache"
+
+
+# ---------------------------------------------------------------- controller
+
+
+def true_timer(pts, n):
+    """Probe timer answering with the TRUE host model's times."""
+    return [
+        predict_spec_us(p.spec, n, p.nbytes, TRUE, codec=p.codec) * 1e-6
+        for p in pts
+    ]
+
+
+class TestController:
+    def make(self, tmp_path, *, on_replan=None, every_k=2, timer=true_timer,
+             clock=None, max_refits=4):
+        cfg = FeedbackConfig(
+            every_k=every_k, band=0.5, min_window=4, min_samples=8,
+            calibration_path=str(tmp_path / "CAL.json"),
+            plan_cache_path=str(tmp_path / "cache.json"),
+            on_replan=on_replan, max_refits=max_refits,
+            run_id="test-run",
+        )
+        kw = {"params": SKEW, "timer": timer}
+        if clock is not None:
+            kw["clock"] = clock
+        return FeedbackController(8, 1 << 20, cfg, **kw)
+
+    def test_recorder_off_is_inert(self, tmp_path):
+        def exploding_timer(pts, n):
+            raise AssertionError("probe timer ran with the recorder off")
+
+        ctl = self.make(tmp_path, timer=exploding_timer)
+        for step in range(1, 20):
+            assert ctl.maybe_tick(step) is None
+        assert ctl.ticks == 0
+
+    def test_tick_cadence(self, tmp_path):
+        ctl = self.make(tmp_path, every_k=3)
+        with flight_recorder(None, 0):
+            assert ctl.maybe_tick(0) is None  # never at step 0
+            assert ctl.maybe_tick(1) is None
+            ctl.maybe_tick(3)
+            assert ctl.ticks == 1
+            assert ctl.maybe_tick(3) is None  # same step: no double tick
+            assert ctl.ticks == 1
+            ctl.maybe_tick(6)
+            assert ctl.ticks == 2
+
+    def test_drift_refit_replan_with_injectable_clock(self, tmp_path):
+        ticks = iter(np.arange(0.0, 100.0, 0.25))
+        replans = []
+
+        def on_replan(plan, params):
+            replans.append((plan.to_ft_topo(), params))
+            return ("new_step_fn", "new_mesh", "new_specs")
+
+        ctl = self.make(tmp_path, on_replan=on_replan,
+                        clock=lambda: float(next(ticks)))
+        with flight_recorder(None, 0) as rec:
+            d1 = ctl.maybe_tick(2)  # 6 probes: starved pre-guard holds
+            assert d1 is None and ctl.refusals == 0
+            d2 = ctl.maybe_tick(4)  # 12 samples: drift -> refit -> replan
+        assert d2 is not None
+        assert d2.invalidated == 0  # nothing cached yet
+        assert d2.rebuilt == ("new_step_fn", "new_mesh", "new_specs")
+        assert replans and replans[0][0]  # plan spec non-empty
+        assert ctl.refits == 1
+        # refit params now track the true host
+        for spec in ("8", "4,2", "ring"):
+            want = predict_spec_us(spec, 8, 1 << 20, TRUE)
+            got = predict_spec_us(spec, 8, 1 << 20, ctl.params)
+            assert got == pytest.approx(want, rel=0.05, abs=1.0)
+        # calibration written with the feedback provenance stamp
+        doc = json.loads((tmp_path / "CAL.json").read_text())
+        sec = doc[ctl._backend_name()]
+        assert sec["source"] == "feedback"
+        assert sec["meta"]["samples"] == 12
+        assert sec["meta"]["run_id"] == "test-run"
+        # events carry the tick/refit trail, clocked by the injected clock
+        kinds = [e["kind"] for e in rec.events]
+        assert kinds.count("feedback_tick") == 2
+        assert kinds.count("feedback_refit") == 1
+        tick_ev = next(e for e in rec.events if e["kind"] == "feedback_tick")
+        assert tick_ev["elapsed_ms"] == pytest.approx(250.0)  # 0.25s clock
+
+    def test_post_refit_residuals_are_judged_against_new_params(self, tmp_path):
+        ctl = self.make(tmp_path)
+        with flight_recorder(None, 0):
+            ctl.maybe_tick(2)
+            assert ctl.maybe_tick(4) is not None  # the refit
+            # probes now agree with the refit constants: no more drift
+            assert ctl.maybe_tick(6) is None
+            assert ctl.maybe_tick(8) is None
+        assert ctl.refits == 1
+
+    def test_refit_invalidates_matching_cache_entry(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        seeded = autotune_plan(
+            8, 1 << 20, codecs=("f32",), top_k=2, cache_path=path,
+            timer=fake_tuner_timer([0.002, 0.001]),
+        )
+        assert seeded.source == "measured"
+        ctl = self.make(tmp_path)
+        with flight_recorder(None, 0):
+            ctl.maybe_tick(2)
+            decision = ctl.maybe_tick(4)
+        assert decision is not None
+        assert decision.invalidated == 1
+        retuned = autotune_plan(
+            8, 1 << 20, codecs=("f32",), top_k=2, cache_path=path,
+            timer=fake_tuner_timer([0.002, 0.001]),
+        )
+        assert retuned.source == "measured"  # re-measured, not a stale hit
+
+    def test_refit_invalidates_every_world_of_the_fingerprint(self, tmp_path):
+        # the refit replaced the CONSTANTS — a multi-axis run's other
+        # sync worlds (tp beside dp) were priced by the same stale
+        # numbers, so their entries must not survive to cache-hit the
+        # rebuilt step back onto the stale winner
+        path = str(tmp_path / "cache.json")
+        kw = dict(codecs=("f32",), top_k=2, cache_path=path,
+                  timer=fake_tuner_timer([0.002, 0.001]))
+        autotune_plan(8, 1 << 20, **kw)   # the probed axis's world
+        autotune_plan(2, 1 << 20, **kw)   # another mesh axis's world
+        ctl = self.make(tmp_path)  # make() points at the same cache.json
+        with flight_recorder(None, 0):
+            ctl.maybe_tick(2)
+            decision = ctl.maybe_tick(4)
+        assert decision is not None
+        assert decision.invalidated == 2
+        assert autotune_plan(2, 1 << 20, **kw).source == "measured"
+
+    def test_degenerate_probe_set_refuses_loudly(self, tmp_path):
+        # a probe set with one shape cannot span the feature space: the
+        # controller must surface the refusal, not fit garbage
+        cfg = FeedbackConfig(
+            every_k=2, band=0.5, min_window=2, min_samples=4,
+            probes=(
+                ProbePoint("8", 1 << 16),
+                ProbePoint("8", 1 << 18),
+                ProbePoint("8", 1 << 20),
+            ),
+        )
+        ctl = FeedbackController(8, 1 << 20, cfg, params=SKEW,
+                                 timer=true_timer)
+        with flight_recorder(None, 0) as rec:
+            ctl.maybe_tick(2)
+            assert ctl.maybe_tick(4) is None
+        assert ctl.refusals >= 1
+        assert any(e["kind"] == "feedback_refused" for e in rec.events)
+
+    def test_warmup_counts_eligible_not_raw_samples(self, tmp_path):
+        # a probe set whose buffer fills with fit-INELIGIBLE samples
+        # (compressed codec) must keep warming up — never a loud
+        # FeedbackRefused every tick — and say once that this set can
+        # never feed a refit
+        cfg = FeedbackConfig(
+            every_k=1, band=0.5, min_window=2, min_samples=4, max_samples=4,
+            probes=tuple(
+                ProbePoint("8", nb, codec="int8")
+                for nb in (1 << 16, 1 << 18, 1 << 19, 1 << 20)
+            ),
+            plan_cache_path=str(tmp_path / "cache.json"),
+        )
+        ctl = FeedbackController(8, 1 << 20, cfg, params=SKEW,
+                                 timer=true_timer)
+        h = TestCalibrationSourceStamp._capture(logging.WARNING)
+        logging.getLogger("flextree.feedback").addHandler(h)
+        try:
+            with flight_recorder(None, 0):
+                for step in range(1, 5):
+                    assert ctl.maybe_tick(step) is None
+        finally:
+            logging.getLogger("flextree.feedback").removeHandler(h)
+        assert ctl.refusals == 0  # warm-up guard, not refuse-every-tick
+        starved = [m for m in h.messages if "cannot feed a refit" in m]
+        assert len(starved) == 1  # said once, not per tick
+
+    def test_max_refits_budget(self, tmp_path):
+        # a timer that never agrees with any fit: after max_refits the
+        # controller stops chasing
+        drifting = iter(range(1, 1000))
+
+        def noisy_timer(pts, n):
+            k = next(drifting)
+            return [
+                predict_spec_us(p.spec, n, p.nbytes, TRUE) * 1e-6 * (k * 7)
+                for p in pts
+            ]
+
+        ctl = self.make(tmp_path, timer=noisy_timer, max_refits=1)
+        with flight_recorder(None, 0):
+            for step in range(2, 30, 2):
+                ctl.maybe_tick(step)
+            assert ctl.refits == 1
+            # spent budget also stops the PROBING, not just the refit —
+            # no tick can ever act again, so paying probe wall-time every
+            # cadence tick for the rest of the run would be pure waste
+            ticks_after = ctl.ticks
+            ctl.maybe_tick(30)
+            ctl.maybe_tick(32)
+            assert ctl.ticks == ticks_after
+
+
+# ----------------------------------------------------------- fit() plumbing
+
+
+class _Dataset:
+    def batch_at(self, step):
+        t = np.full((2, 4), float(step + 1))
+        return t, t
+
+
+def _host_step(tag):
+    def step_fn(state, tokens, targets):
+        s = int(np.asarray(state["step"]))
+        return ({"step": np.int64(s + 1), "tag": tag}, {"loss": 0.5})
+
+    return step_fn
+
+
+class TestFitPlumbing:
+    def test_fit_swaps_step_through_replan_hook(self, tmp_path):
+        from flextree_tpu.parallel.loop import FitConfig, Supervision, fit
+
+        def on_replan(plan, params):
+            return (_host_step("rebuilt"), None, None)
+
+        cfg = FeedbackConfig(
+            every_k=2, band=0.5, min_window=4, min_samples=8,
+            plan_cache_path=str(tmp_path / "cache.json"),
+            on_replan=on_replan,
+        )
+        ctl = FeedbackController(8, 1 << 20, cfg, params=SKEW,
+                                 timer=true_timer)
+        with flight_recorder(None, 0) as rec:
+            result = fit(
+                {"step": np.int64(0), "tag": "original"},
+                _host_step("original"), _Dataset(),
+                FitConfig(num_steps=8, log_every=0, prefetch=0),
+                supervision=Supervision(feedback=ctl),
+            )
+        assert result.report.feedback_refits == 1
+        assert result.report.feedback_replans == 1
+        assert result.report.feedback_refusals == 0
+        # the swap really took: steps after the replan ran the rebuilt fn
+        assert result.state["tag"] == "rebuilt"
+        kinds = [e["kind"] for e in rec.events]
+        assert "feedback_replan" in kinds
+        replan_ev = next(
+            e for e in rec.events if e["kind"] == "feedback_replan"
+        )
+        assert replan_ev["swapped"] is True
+        assert replan_ev["step"] == 4  # tick 1 at 2 (starved), refit at 4
+
+    def test_fit_records_plan_when_hook_declines(self, tmp_path):
+        from flextree_tpu.parallel.loop import FitConfig, Supervision, fit
+
+        cfg = FeedbackConfig(
+            every_k=2, band=0.5, min_window=4, min_samples=8,
+            plan_cache_path=str(tmp_path / "cache.json"),
+            on_replan=lambda plan, params: None,
+        )
+        ctl = FeedbackController(8, 1 << 20, cfg, params=SKEW,
+                                 timer=true_timer)
+        with flight_recorder(None, 0) as rec:
+            result = fit(
+                {"step": np.int64(0), "tag": "original"},
+                _host_step("original"), _Dataset(),
+                FitConfig(num_steps=6, log_every=0, prefetch=0),
+                supervision=Supervision(feedback=ctl),
+            )
+        assert result.report.feedback_refits == 1
+        assert result.report.feedback_replans == 0
+        assert result.state["tag"] == "original"
+        replan_ev = next(
+            e for e in rec.events if e["kind"] == "feedback_replan"
+        )
+        assert replan_ev["swapped"] is False
+
+    def test_fit_survives_raising_tick(self, tmp_path):
+        # the obs contract: telemetry never kills the run.  A tick that
+        # raises (unwritable calibration path, failed probe compile, a
+        # broken rebuild hook) disarms feedback and training continues
+        # on the current plan to the last step.
+        from flextree_tpu.parallel.loop import FitConfig, Supervision, fit
+
+        def exploding_timer(pts, n):
+            raise OSError("probe wire fell off")
+
+        ctl = FeedbackController(
+            8, 1 << 20,
+            FeedbackConfig(every_k=2,
+                           plan_cache_path=str(tmp_path / "cache.json")),
+            params=SKEW, timer=exploding_timer,
+        )
+        h = TestCalibrationSourceStamp._capture(logging.ERROR)
+        logging.getLogger("flextree.train").addHandler(h)
+        try:
+            with flight_recorder(None, 0) as rec:
+                result = fit(
+                    {"step": np.int64(0), "tag": "original"},
+                    _host_step("original"), _Dataset(),
+                    FitConfig(num_steps=8, log_every=0, prefetch=0),
+                    supervision=Supervision(feedback=ctl),
+                )
+        finally:
+            logging.getLogger("flextree.train").removeHandler(h)
+        assert int(np.asarray(result.state["step"])) == 8
+        assert result.report.feedback_refits == 0
+        assert result.state["tag"] == "original"
+        # disarmed after the first failure: exactly one error event, and
+        # no tick fired on the later cadence steps
+        errors = [e for e in rec.events if e["kind"] == "feedback_error"]
+        assert len(errors) == 1 and errors[0]["step"] == 2
+        assert ctl.ticks == 1
+        assert any("disarmed" in m for m in h.messages)
+
+    def test_fit_armed_without_recorder_pays_nothing(self):
+        from flextree_tpu.parallel.loop import FitConfig, Supervision, fit
+
+        def exploding_timer(pts, n):
+            raise AssertionError("probe timer ran with the recorder off")
+
+        ctl = FeedbackController(
+            8, 1 << 20, FeedbackConfig(every_k=1), params=SKEW,
+            timer=exploding_timer,
+        )
+        result = fit(
+            {"step": np.int64(0), "tag": "x"}, _host_step("x"), _Dataset(),
+            FitConfig(num_steps=5, log_every=0, prefetch=0),
+            supervision=Supervision(feedback=ctl),
+        )
+        assert ctl.ticks == 0
+        assert result.report.feedback_refits == 0
+        assert result.report.feedback_replans == 0
+
+    def test_no_tick_after_the_final_step(self):
+        # a tick landing on num_steps would probe (and possibly refit +
+        # rebuild) a step that never runs — the loop must skip it
+        from flextree_tpu.parallel.loop import FitConfig, Supervision, fit
+
+        ctl = FeedbackController(
+            8, 1 << 20, FeedbackConfig(every_k=6), params=SKEW,
+            timer=true_timer,
+        )
+        with flight_recorder(None, 0):
+            fit(
+                {"step": np.int64(0), "tag": "x"}, _host_step("x"),
+                _Dataset(), FitConfig(num_steps=6, log_every=0, prefetch=0),
+                supervision=Supervision(feedback=ctl),
+            )
+        assert ctl.ticks == 0
+
+    def test_trainer_default_never_mutates_measured_calibration(
+        self, tmp_path, monkeypatch
+    ):
+        # review pin: with $FLEXTREE_CALIBRATION pointing at a measured
+        # host artifact and no --feedback-calibration, the trainer must
+        # write refits to a run-local COPY — the user's file stays
+        # byte-identical no matter what the feedback loop does to its
+        # own target
+        from flextree_tpu.planner.calibrate import save_calibration
+        from flextree_tpu.trainer import main
+
+        user_cal = str(tmp_path / "CALIBRATION.json")
+        save_calibration(user_cal, TRUE, backend="cpu", fingerprint="fp-x")
+        with open(user_cal) as f:
+            before = f.read()
+        obs_dir = str(tmp_path / "obs")
+        monkeypatch.setenv("FLEXTREE_CALIBRATION", user_cal)
+        rc = main([
+            "--steps", "2", "--log-every", "0", "--batch", "8",
+            "--seq-len", "32", "--d-model", "32", "--d-ff", "64",
+            "--corpus-tokens", "20000", "--obs-dir", obs_dir,
+            "--feedback-every", "1000",
+        ])
+        assert rc == 0
+        with open(user_cal) as f:
+            assert f.read() == before
+        run_local = os.path.join(obs_dir, "CALIBRATION.feedback.json")
+        assert os.path.exists(run_local)
+        with open(run_local) as f:
+            assert f.read() == before  # seeded from the user's file
+        # the fit-end finally restored the env for in-process callers
+        assert os.environ["FLEXTREE_CALIBRATION"] == user_cal
+
+
+# ----------------------------------------------------------------- helpers
+
+
+class TestCalibrationSourceStamp:
+    """Satellite: schema-4 provenance stamp — sections say whether their
+    constants were measured or feedback-fitted, pre-stamp sections load
+    NON-SILENTLY, and mismatch warnings name the source."""
+
+    @staticmethod
+    def _capture(level=logging.INFO):
+        class _H(logging.Handler):
+            def __init__(self):
+                super().__init__(level)
+                self.messages = []
+
+            def emit(self, record):
+                self.messages.append(record.getMessage())
+
+        return _H()
+
+    def test_pre_stamp_section_loads_with_notice(self, tmp_path):
+        from flextree_tpu.planner.calibrate import (
+            load_calibration,
+            save_calibration,
+        )
+
+        path = str(tmp_path / "CALIBRATION.json")
+        save_calibration(path, TRUE, backend="cpu", fingerprint="fp-host")
+        with open(path) as f:
+            doc = json.load(f)
+        del doc["cpu"]["source"]  # a pre-schema-4 section
+        doc["cpu"]["schema"] = 3
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        log = logging.getLogger("flextree.planner")
+        h = self._capture()
+        log.addHandler(h)
+        old_level = log.level
+        log.setLevel(logging.INFO)
+        try:
+            assert (
+                load_calibration(path, backend="cpu", fingerprint="fp-host")
+                == TRUE
+            )
+        finally:
+            log.setLevel(old_level)
+            log.removeHandler(h)
+        assert any("predates source stamping" in m for m in h.messages)
+
+    def test_mismatch_warning_names_source(self, tmp_path):
+        from flextree_tpu.planner.calibrate import (
+            load_calibration,
+            save_calibration,
+        )
+
+        path = str(tmp_path / "CALIBRATION.json")
+        save_calibration(
+            path, TRUE, backend="cpu",
+            fingerprint="cpu|other-host|n64|jax0.0.1", source="feedback",
+        )
+        log = logging.getLogger("flextree.planner")
+        h = self._capture(logging.WARNING)
+        log.addHandler(h)
+        try:
+            assert (
+                load_calibration(
+                    path, backend="cpu",
+                    fingerprint="cpu|this-host|n8|jax0.4.0",
+                )
+                is None
+            )
+        finally:
+            log.removeHandler(h)
+        assert any("source=feedback" in m for m in h.messages)
+
+
+class TestHelpers:
+    def test_parse_spec(self):
+        assert fb._parse_spec("8") == ((8,), 0)
+        assert fb._parse_spec("4,2") == ((4, 2), 0)
+        assert fb._parse_spec("4*2") == ((4, 2), 0)
+        assert fb._parse_spec("3,2+2") == ((3, 2), 2)
+        assert fb._parse_spec("ring") == ((1,), 0)
+        assert fb._parse_spec("1") == ((1,), 0)
+        assert fb._parse_spec("psum") == (None, 0)
+
+    def test_default_probe_points_span_the_space(self):
+        pts = default_probe_points(8, 1 << 20)
+        specs = {p.spec for p in pts}
+        assert "8" in specs and "ring" in specs
+        assert any("," in s for s in specs)  # a multi-stage shape
+        assert len({(p.spec, p.nbytes) for p in pts}) >= 4
+        # degenerate world still yields a usable set
+        assert default_probe_points(2, 1 << 10)
+
+    def test_predict_spec_us_matches_calibrate(self):
+        from flextree_tpu.planner import predict_us
+
+        for spec, widths in (("8", (8,)), ("4,2", (4, 2)), ("ring", (1,))):
+            assert predict_spec_us(spec, 8, 1 << 18, TRUE) == pytest.approx(
+                predict_us(TRUE, widths, 8, 1 << 18)
+            )
+        assert predict_spec_us("psum", 8, 1 << 18, TRUE) is None
+
+    def test_obs_cli_residuals(self, tmp_path, capsys):
+        from flextree_tpu.obs.__main__ import main
+
+        with flight_recorder(tmp_path, 0) as rec:
+            rec.record("bucket_planned", **{
+                k: v for k, v in planned_ev("4,2", 512, 21.0).items()
+                if k not in ("ts", "rank", "seq", "kind")
+            })
+            rec.record("bucket_measured", **{
+                k: v for k, v in measured_ev("4,2", 512, 63.0).items()
+                if k not in ("ts", "rank", "seq", "kind")
+            })
+        assert main(["residuals", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "4,2" in out and "med |r|" in out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert main(["residuals", str(empty)]) == 1

@@ -60,6 +60,7 @@ def cpu_section(out: str) -> None:
         out,
         params,
         backend="cpu",
+        source="measured",  # direct-measurement protocol, not a feedback refit
         meta={
             "build": artifact_meta(),
             "date": datetime.date.today().isoformat(),
@@ -152,6 +153,7 @@ print("RESULT " + json.dumps({{
         out,
         params,
         backend=section,
+        source="measured",
         meta={
             "build": artifact_meta(),
             "date": datetime.date.today().isoformat(),
