@@ -26,7 +26,14 @@ trainer's ``--obs-dir``/``--flight-recorder`` flags).  See
 timeline in Perfetto.
 """
 
-from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    WindowedHistogram,
+    merged_window_percentile,
+)
 from .provenance import bucket_provenance, topo_spec
 from .recorder import (
     FlightRecorder,
@@ -55,6 +62,8 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "WindowedHistogram",
+    "merged_window_percentile",
     "MetricsRegistry",
     "FlightRecorder",
     "flight_recorder",

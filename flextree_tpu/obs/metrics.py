@@ -24,14 +24,22 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 
 __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "WindowedHistogram",
     "MetricsRegistry",
     "DEFAULT_MS_BUCKETS",
+    "merged_window_percentile",
 ]
+
+# injection point for the windowed-histogram tests (patch this, not
+# time.monotonic): interval rotation is pure arithmetic over it, the same
+# pattern runtime.supervisor._wall / serving.engine._now use
+_now = time.monotonic
 
 #: Default latency bucket upper edges (milliseconds): ~1-2-5 decades from
 #: 100 µs to 100 s — wide enough for TTFTs and train steps alike.  13
@@ -89,6 +97,28 @@ class Gauge:
         return int(v) if float(v).is_integer() else v
 
 
+def _bucket_percentile(q, edges, counts, count, minv, maxv) -> float:
+    """The shared percentile-from-buckets interpolation: cumulative
+    histograms and windowed snapshots must answer from ONE definition, or
+    the arbiter's breach check and ``engine.report()`` could disagree
+    about the same samples."""
+    if count == 0:
+        return math.nan
+    target = q / 100.0 * count
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        lo_edge = 0.0 if i == 0 else edges[i - 1]
+        hi_edge = edges[i] if i < len(edges) else maxv
+        if cum + c >= target:
+            frac = (target - cum) / c
+            lo = max(lo_edge, minv if minv is not None else lo_edge)
+            return min(lo + frac * (hi_edge - lo), hi_edge)
+        cum += c
+    return maxv if maxv is not None else math.nan
+
+
 class Histogram:
     """Fixed-bucket histogram: ``buckets`` are increasing upper edges; an
     implicit overflow bucket catches values past the last edge."""
@@ -105,8 +135,7 @@ class Histogram:
         self.max: float | None = None
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
-        value = float(value)
+    def _bucket_index(self, value: float) -> int:
         # linear scan: bucket lists are ~a dozen edges and most samples
         # land early; a bisect would save nothing measurable
         i = 0
@@ -115,6 +144,11 @@ class Histogram:
                 break
         else:
             i = len(self.edges)
+        return i
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        i = self._bucket_index(value)
         with self._lock:
             self.counts[i] += 1
             self.count += 1
@@ -130,21 +164,9 @@ class Histogram:
         histogram keeps past the last edge)."""
         if not 0.0 <= q <= 100.0:
             raise ValueError(f"percentile must be in [0, 100], got {q}")
-        if self.count == 0:
-            return math.nan
-        target = q / 100.0 * self.count
-        cum = 0
-        for i, c in enumerate(self.counts):
-            if c == 0:
-                continue
-            lo_edge = 0.0 if i == 0 else self.edges[i - 1]
-            hi_edge = self.edges[i] if i < len(self.edges) else self.max
-            if cum + c >= target:
-                frac = (target - cum) / c
-                lo = max(lo_edge, self.min if self.min is not None else lo_edge)
-                return min(lo + frac * (hi_edge - lo), hi_edge)
-            cum += c
-        return self.max if self.max is not None else math.nan
+        return _bucket_percentile(
+            q, self.edges, self.counts, self.count, self.min, self.max
+        )
 
     @property
     def mean(self) -> float:
@@ -168,6 +190,182 @@ class Histogram:
                 if c
             },
         }
+
+
+class _WindowSlot:
+    """One interval's sub-histogram: bucket counts + running stats, keyed
+    by its absolute interval index so stale slots invalidate lazily."""
+
+    __slots__ = ("k", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets
+        self.reset(-1)
+
+    def reset(self, k: int) -> None:
+        self.k = k
+        for i in range(len(self.counts)):
+            self.counts[i] = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+
+class WindowedHistogram(Histogram):
+    """A :class:`Histogram` that ALSO answers over a rolling window.
+
+    The cumulative view (everything :class:`Histogram` offers) dilutes a
+    fresh breach after a long quiet run — a thousand healthy TTFTs drown
+    the ten bad ones an SLO check needs to see *now*.  The windowed view
+    keeps a ring of ``intervals`` per-interval sub-histograms (absolute
+    interval index = ``now // interval_s``; a slot whose index fell out
+    of the window reads as empty), so :meth:`window_percentile` answers
+    over the last ``interval_s * intervals`` seconds only, with the SAME
+    bucket interpolation as the cumulative percentile — the arbiter's
+    breach check and ``engine.report()`` share one definition by
+    construction.
+
+    Memory stays bounded: the ring is ``intervals × (edges + 1)`` ints
+    regardless of traffic.  The clock is the module's ``_now`` hook
+    (monotonic; injectable for tests), or an explicit ``now=`` for
+    deterministic replay.
+    """
+
+    def __init__(
+        self,
+        buckets=DEFAULT_MS_BUCKETS,
+        *,
+        interval_s: float = 1.0,
+        intervals: int = 10,
+    ):
+        super().__init__(buckets)
+        if interval_s <= 0 or intervals < 1:
+            raise ValueError(
+                f"need interval_s > 0 and intervals >= 1, got "
+                f"{interval_s}/{intervals}"
+            )
+        self.interval_s = float(interval_s)
+        self.intervals = int(intervals)
+        self._slots = [
+            _WindowSlot(len(self.edges) + 1) for _ in range(self.intervals)
+        ]
+
+    @property
+    def window_s(self) -> float:
+        return self.interval_s * self.intervals
+
+    def observe(self, value: float, now: float | None = None) -> None:
+        # one edge scan and ONE lock acquisition for both views: a
+        # concurrent snapshot must never see the sample in the cumulative
+        # count but not the window (or pay a second lock on the hot path)
+        value = float(value)
+        now = _now() if now is None else now
+        k = int(now // self.interval_s)
+        i = self._bucket_index(value)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            slot = self._slots[k % self.intervals]
+            if slot.k != k:
+                slot.reset(k)
+            slot.counts[i] += 1
+            slot.count += 1
+            slot.sum += value
+            slot.min = value if slot.min is None else min(slot.min, value)
+            slot.max = value if slot.max is None else max(slot.max, value)
+
+    def window_counts(self, now: float | None = None):
+        """Merged ``(counts, count, sum, min, max)`` over the live window
+        — slots whose interval index fell behind ``now`` by more than
+        ``intervals`` read as empty (lazy expiry: nothing rotates on a
+        quiet histogram)."""
+        now = _now() if now is None else now
+        k = int(now // self.interval_s)
+        counts = [0] * (len(self.edges) + 1)
+        count, total = 0, 0.0
+        minv: float | None = None
+        maxv: float | None = None
+        with self._lock:
+            for slot in self._slots:
+                if not (k - self.intervals < slot.k <= k) or slot.count == 0:
+                    continue
+                for i, c in enumerate(slot.counts):
+                    counts[i] += c
+                count += slot.count
+                total += slot.sum
+                if slot.min is not None:
+                    minv = slot.min if minv is None else min(minv, slot.min)
+                if slot.max is not None:
+                    maxv = slot.max if maxv is None else max(maxv, slot.max)
+        return counts, count, total, minv, maxv
+
+    def window_percentile(self, q: float, now: float | None = None) -> float:
+        """The ``q``-th percentile over the rolling window (NaN when the
+        window holds no samples — the caller decides what "no evidence"
+        means; the arbiter treats it as in-SLO)."""
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile must be in [0, 100], got {q}")
+        counts, count, _, minv, maxv = self.window_counts(now)
+        return _bucket_percentile(q, self.edges, counts, count, minv, maxv)
+
+    def window_count(self, now: float | None = None) -> int:
+        return self.window_counts(now)[1]
+
+    def to_payload(self) -> dict:
+        p = super().to_payload()
+        counts, count, total, minv, maxv = self.window_counts()
+        p["window"] = {
+            "seconds": round(self.window_s, 6),
+            "count": count,
+            "min": minv,
+            "max": maxv,
+            "mean": round(total / count, 6) if count else None,
+            "p50": round(
+                _bucket_percentile(50, self.edges, counts, count, minv, maxv), 6
+            ) if count else None,
+            "p99": round(
+                _bucket_percentile(99, self.edges, counts, count, minv, maxv), 6
+            ) if count else None,
+        }
+        return p
+
+
+def merged_window_percentile(
+    hists, q: float, now: float | None = None
+) -> tuple[float, int]:
+    """``(percentile, sample_count)`` over the union of several
+    :class:`WindowedHistogram` windows — the arbiter's cross-replica SLO
+    reading (each serving replica owns its registry; the SLO is a
+    property of the POOL).  Histograms must share bucket edges; NaN with
+    count 0 when every window is empty."""
+    hists = [h for h in hists if h is not None]
+    if not hists:
+        return math.nan, 0
+    edges = hists[0].edges
+    for h in hists[1:]:
+        if h.edges != edges:
+            raise ValueError(
+                "merged_window_percentile needs identical bucket edges: "
+                f"{h.edges} vs {edges}"
+            )
+    counts = [0] * (len(edges) + 1)
+    count = 0
+    minv: float | None = None
+    maxv: float | None = None
+    for h in hists:
+        c, n, _, lo, hi = h.window_counts(now)
+        for i, v in enumerate(c):
+            counts[i] += v
+        count += n
+        if lo is not None:
+            minv = lo if minv is None else min(minv, lo)
+        if hi is not None:
+            maxv = hi if maxv is None else max(maxv, hi)
+    return _bucket_percentile(q, edges, counts, count, minv, maxv), count
 
 
 class MetricsRegistry:
@@ -201,6 +399,27 @@ class MetricsRegistry:
 
     def histogram(self, name: str, buckets=DEFAULT_MS_BUCKETS) -> Histogram:
         return self._get(name, Histogram, lambda: Histogram(buckets))
+
+    def windowed_histogram(
+        self,
+        name: str,
+        buckets=DEFAULT_MS_BUCKETS,
+        *,
+        interval_s: float = 1.0,
+        intervals: int = 10,
+    ) -> WindowedHistogram:
+        """A histogram that ALSO answers rolling-window percentiles (the
+        arbiter's SLO view).  Create it BEFORE any plain ``histogram()``
+        call for the same name: a ``WindowedHistogram`` satisfies later
+        ``histogram()`` lookups (it IS one), but a plain histogram cannot
+        be upgraded in place."""
+        return self._get(
+            name,
+            WindowedHistogram,
+            lambda: WindowedHistogram(
+                buckets, interval_s=interval_s, intervals=intervals
+            ),
+        )
 
     def __contains__(self, name: str) -> bool:
         return name in self._instruments

@@ -19,6 +19,11 @@ Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``:
 - serving request lifecycles (``serve_admit`` → ``serve_retire``)
   become **flow arrows** keyed by request id — a re-routed request's
   arrow visibly jumps tracks;
+- arbiter decisions (``slo_breach``, ``lease_preempt``/``lease_grant``/
+  ``lease_return``, the trainer's ``lease_resize``) render on a
+  dedicated **arbiter lane** with the SLO reading in their ``args``, so
+  every chip reallocation is visible beside the train/serve spans it
+  caused;
 - everything else is an instant event carrying its fields as ``args``.
 
 Timestamps are wall-clock (the recorders stamp with ``time.time`` for
@@ -50,6 +55,14 @@ __all__ = [
 
 #: kinds rendered on the heartbeat lane (tid 1) instead of the main lane
 _HEARTBEAT_KINDS = frozenset({"heartbeat"})
+
+#: arbiter-decision kinds rendered on their own lane (tid 2), so every
+#: chip reallocation is visible BESIDE the train/serve spans it caused —
+#: slo_breach carries the SLO reading, the lease_* kinds carry the chips
+_ARBITER_KINDS = frozenset(
+    {"slo_breach", "lease_grant", "lease_preempt", "lease_return",
+     "lease_resize"}
+)
 
 #: paired-kind suffixes → complete events
 _START_SUFFIX, _END_SUFFIX = "_start", "_end"
@@ -137,6 +150,7 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
 
     trace: list[dict] = []
     ranks: dict[int, str] = {}
+    arbiter_ranks: set = set()
     open_pairs: dict = {}
     flow_open: set = set()
 
@@ -145,6 +159,9 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
         ranks.setdefault(rank, str(ev.get("src", "rank")))
         kind = str(ev["kind"])
         tid = 1 if kind in _HEARTBEAT_KINDS else 0
+        if kind in _ARBITER_KINDS:
+            tid = 2
+            arbiter_ranks.add(rank)
         common = {"pid": rank, "tid": tid, "ts": us(ev["ts"])}
 
         if kind.endswith(_START_SUFFIX):
@@ -223,6 +240,15 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
                 trace.append({**flow, "ph": "t"})
             continue
 
+        if kind in _ARBITER_KINDS:
+            # process-scoped instants: a chip reallocation concerns every
+            # lane of the track, not one thread's local moment
+            trace.append(
+                {"name": kind, "cat": "arbiter", "ph": "i", "s": "p",
+                 **common, "args": _args(ev)}
+            )
+            continue
+
         scope = "p" if kind in ("dump", "shrink", "preempt") else "t"
         trace.append(
             {"name": kind, "cat": kind, "ph": "i", "s": scope, **common,
@@ -262,6 +288,11 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
             {"name": "thread_name", "ph": "M", "pid": rank, "tid": 1,
              "args": {"name": "heartbeat"}}
         )
+        if rank in arbiter_ranks:
+            trace.append(
+                {"name": "thread_name", "ph": "M", "pid": rank, "tid": 2,
+                 "args": {"name": "arbiter"}}
+            )
 
     doc = {
         "traceEvents": trace,

@@ -187,6 +187,11 @@ class RunReport:
     feedback_refits: int = 0  # drift-triggered constant refits
     feedback_replans: int = 0  # refits whose on_replan hook swapped the step
     feedback_refusals: int = 0  # refits refused (starved/degenerate samples)
+    # --- arbiter chip leases (empty when fit ran without an arbiter) ---
+    # one entry per applied grant change — {"step", "epoch", "chips",
+    # "topo", "bitwise_resume"}: the checkpoint→rebuild→restore cycle's
+    # in-run proof that the resize lost nothing (docs/ARBITER.md)
+    lease_epochs: list = dataclasses.field(default_factory=list)
     # membership epochs: entry 0 is the starting world, one more per live
     # shrink — {"step", "alive", "configured", "topo", "dead"}
     membership_epochs: list = dataclasses.field(default_factory=list)
@@ -261,6 +266,7 @@ def fit(
     mesh=None,
     state_specs=None,
     supervision: Supervision | None = None,
+    arbiter: Any = None,
     state_pack: Callable | None = None,
     state_unpack: Callable | None = None,
 ) -> FitResult:
@@ -285,6 +291,18 @@ def fit(
     watchdog, heartbeat membership with live shrink-to-survivors,
     straggler accounting, preemption checkpointing; see
     :class:`Supervision`.  Without it the loop is the historical one.
+
+    ``arbiter`` (optional) is this run's chip-lease handle — a
+    :class:`~flextree_tpu.runtime.TrainLeaseClient` (or anything with the
+    same ``poll(step)`` / ``ack(directive)`` / ``on_resize`` surface).
+    When the pool arbiter moves chips (docs/ARBITER.md), the loop rides
+    the preemption-checkpoint machinery in place: drain pending saves,
+    checkpoint NOW, rebuild for the new chip count through the handle's
+    ``on_resize`` hook (the same 3-/5-tuple swap ``on_shrink`` uses),
+    restore, verify the restored packed state is BITWISE the one just
+    saved, and ack the lease epoch — only then may the arbiter hand the
+    revoked chips to serving.  Each applied change is recorded in
+    ``RunReport.lease_epochs``.
     """
     report = RunReport()
     sup = supervision
@@ -325,6 +343,97 @@ def fit(
         return None
 
     batches = _batches(start)
+
+    def _lease_resize(at_step, directive):
+        """Apply an arbiter grant change: checkpoint now, rebuild for the
+        new chip count, restore, prove the resume bitwise, ack.
+
+        The cycle is the SIGTERM-preemption fast path composed with the
+        shrink path's rebuild — but triggered by the lease ledger and
+        resumed IN-PROCESS (the world changed size, the process did not).
+        The bitwise proof compares the packed (world-independent) state
+        on both sides of the cycle: what the preempt checkpoint saved
+        must be exactly what the resized world runs from — zero steps
+        lost, by construction and by check.
+        """
+        nonlocal state, step, batches
+        nonlocal cur_step_fn, cur_mesh, cur_specs, cur_pack, cur_unpack
+        from ..planner.choose import replan_for_survivors
+
+        n = directive.n
+        if n < 1:
+            raise ValueError(
+                f"lease epoch {directive.epoch} grants training zero chips "
+                "— the arbiter's min_train_chips floor should forbid this"
+            )
+        configured = max(getattr(arbiter, "configured", None) or n, n)
+        nbytes = getattr(arbiter, "nbytes_hint", 4 << 20)
+        plan = replan_for_survivors(n, nbytes, configured=configured)
+        log.warning(
+            "lease resize at step %d: epoch %d grants chips %s (%d); "
+            "replanned topo %s",
+            at_step, directive.epoch, list(directive.chips), n,
+            plan.to_ft_topo(),
+        )
+        if sup is not None and sup.background_saver is not None:
+            # the restore below must never race an in-flight save's
+            # rotation (the background saver forbids two writers)
+            sup.background_saver.drain(None)
+        old_pack = cur_pack
+        packed = _packed(state)
+        pre_host = jax.device_get(packed)
+        if cfg.ckpt_dir:
+            # checkpoint NOW — the preemption fast path's save, so the
+            # revoked chips carry no un-persisted work when they leave
+            save_train_state(cfg.ckpt_dir, packed, max_to_keep=cfg.max_to_keep)
+        on_resize = getattr(arbiter, "on_resize", None)
+        rebuilt = (
+            on_resize(directive.chips, plan) if on_resize is not None else None
+        )
+        if rebuilt is not None:
+            (cur_step_fn, cur_mesh, cur_specs,
+             cur_pack, cur_unpack) = _apply_rebuild(
+                 rebuilt, cur_pack, cur_unpack)
+        if cfg.ckpt_dir and latest_checkpoint(cfg.ckpt_dir):
+            state = _restore()
+            step = int(np.asarray(jax.device_get(state["step"])))
+        elif old_pack is not None or cur_unpack is not None:
+            # no checkpoint dir: convert the live state through the
+            # packed layout, exactly what the shrink path does
+            state = (
+                cur_unpack(pre_host) if cur_unpack is not None else pre_host
+            )
+        # the bitwise-resume proof: the new world's packed view of the
+        # restored state vs the packed state the checkpoint saved
+        post_host = jax.device_get(_packed(state))
+        pre_leaves = jax.tree.leaves(pre_host)
+        post_leaves = jax.tree.leaves(post_host)
+        bitwise = len(pre_leaves) == len(post_leaves) and all(
+            np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            for a, b in zip(pre_leaves, post_leaves)
+        )
+        if not bitwise:
+            log.error(
+                "lease resize at step %d is NOT a bitwise resume — the "
+                "packed state changed across the preempt/restore cycle",
+                at_step,
+            )
+        report.lease_epochs.append(
+            {
+                "step": at_step,
+                "epoch": directive.epoch,
+                "chips": list(directive.chips),
+                "topo": plan.to_ft_topo(),
+                "bitwise_resume": bitwise,
+            }
+        )
+        record_event(
+            "lease_resize", step=at_step, epoch=directive.epoch,
+            chips=list(directive.chips), n=n, topo=plan.to_ft_topo(),
+            bitwise_resume=bitwise,
+        )
+        arbiter.ack(directive)
+        batches = _batches(step)
 
     # ---- runtime supervision wiring (sup=None leaves the historical loop)
     watchdog = None
@@ -543,6 +652,14 @@ def fit(
                     and _membership_tick(step) == "shrunk"
                 ):
                     continue
+            if arbiter is not None:
+                # the arbiter moved chips: apply the grant before the next
+                # step (checkpoint → rebuild → restore → ack), then loop —
+                # the resized world re-reads its batch stream from `step`
+                directive = arbiter.poll(step)
+                if directive is not None:
+                    _lease_resize(step, directive)
+                    continue
             tokens, targets = (
                 next(batches) if batches is not None else dataset.batch_at(step)
             )
@@ -735,6 +852,7 @@ def fit(
             reg.counter("train.feedback_refits").inc(report.feedback_refits)
             reg.counter("train.feedback_replans").inc(report.feedback_replans)
             reg.counter("train.feedback_refusals").inc(report.feedback_refusals)
+            reg.counter("train.lease_resizes").inc(len(report.lease_epochs))
             reg.gauge("train.last_step").set(step)
             report.metrics = reg.snapshot()
         record_event("fit_end", id=start, step=step)

@@ -18,6 +18,12 @@ collectives on this pin, so the detection has to live at the host level:
   block; the simulator's :class:`~flextree_tpu.backends.simulator.Mailbox`
   carries the same contract at message granularity
   (``FaultPlan.recv_timeout`` → ``StageTimeout``).
+- :mod:`.leases` — the chip-lease protocol.  A
+  :class:`LeaseLedger` on the same heartbeat directory carries the
+  arbiter's epoch-numbered chip grants (atomic publish, per-holder acks);
+  a :class:`TrainLeaseClient` is the handle ``fit(arbiter=...)`` polls to
+  shrink/expand the training world when the arbiter moves chips between
+  training and serving (``flextree_tpu.arbiter``, docs/ARBITER.md).
 - :mod:`.preemption` — preemption-aware checkpointing.  A
   :class:`PreemptionGuard` turns SIGTERM into a "checkpoint now" fast
   path inside ``fit``; a :class:`BackgroundSaver` moves periodic saves
@@ -33,6 +39,15 @@ step timeouts, stragglers, preemption checkpoints) in the
 ``CHAOS_RUNTIME.json``); see docs/FAILURE_MODEL.md §Runtime failures.
 """
 
+from .leases import (
+    ARBITER,
+    SERVE,
+    TRAIN,
+    LeaseGrant,
+    LeaseLedger,
+    ResizeDirective,
+    TrainLeaseClient,
+)
 from .preemption import BackgroundSaver, PreemptionGuard
 from .supervisor import (
     DEAD,
@@ -60,4 +75,11 @@ __all__ = [
     "BackgroundSaver",
     "FT_STEP_TIMEOUT_ENV",
     "FT_LEASE_ENV",
+    "LeaseGrant",
+    "LeaseLedger",
+    "ResizeDirective",
+    "TrainLeaseClient",
+    "TRAIN",
+    "SERVE",
+    "ARBITER",
 ]

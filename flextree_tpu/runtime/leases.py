@@ -1,0 +1,297 @@
+"""Chip-lease protocol: arbiter-published grants on the heartbeat dir.
+
+The heartbeat directory already carries the runtime's only cross-process
+truths — per-rank liveness beats, atomically replaced, wall-stamped.
+Leases ride the same transport: the **arbiter** (``flextree_tpu.arbiter``)
+is the single writer of one ``lease_ledger.json`` naming, per holder
+(``"train"`` / ``"serve"``), exactly which chips that holder may use at
+which **epoch**; every holder polls the ledger and, when the epoch moved,
+applies the new grant and writes an ``lease_ack_{holder}.json`` naming
+the epoch it now runs under.  The handshake is the whole protocol:
+
+1. the arbiter revokes chips from a holder by publishing epoch ``E`` with
+   a smaller grant (the revoked chips are parked on the ``"arbiter"``
+   holder — granted to nobody while in flight);
+2. the holder sees ``E``, stops using the revoked chips (training:
+   checkpoint-now + shrink-to-survivors rebuild — the SIGTERM-preemption
+   path, arbiter-triggered), and **acks** ``E``;
+3. only after the ack does the arbiter publish ``E+1`` granting those
+   chips to the other holder — a chip is never promised to two holders,
+   because the grant that takes it away is acknowledged before the grant
+   that hands it on exists.
+
+Every write is atomic (tmp + ``os.replace``, the beat-file discipline),
+so a reader never sees a torn ledger; a mid-rewrite crash leaves the
+previous epoch, which is always a consistent assignment.  The files are
+human-readable JSON — ``cat $FT_HB_DIR/lease_ledger.json`` IS the
+debugging story.
+
+:class:`TrainLeaseClient` is training's side: the handle
+``parallel.loop.fit(arbiter=...)`` polls every loop iteration (throttled
+to ``poll_interval_s`` — a file read per step would be rude) and turns an
+epoch move into a :class:`ResizeDirective` the loop applies through the
+same checkpoint → rebuild → restore machinery the shrink path proved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Callable
+
+from ..utils.logging import get_logger
+
+__all__ = [
+    "LEASE_FILE",
+    "TRAIN",
+    "SERVE",
+    "ARBITER",
+    "LeaseGrant",
+    "LeaseLedger",
+    "ResizeDirective",
+    "TrainLeaseClient",
+]
+
+log = get_logger("flextree.runtime")
+
+LEASE_FILE = "lease_ledger.json"
+_ACK_FMT = "lease_ack_{holder}.json"
+
+# holder names: the two tenants plus the arbiter's own parking slot for
+# chips mid-handoff (revoked from one holder, not yet granted to the other)
+TRAIN, SERVE, ARBITER = "train", "serve", "arbiter"
+
+# injection point for tests (patch this, not time.time): lease files are
+# read across processes, so stamps are wall time like heartbeat beats
+_wall = time.time
+
+
+def _atomic_write_json(dir: str, path: str, payload: dict) -> None:
+    fd, tmp = tempfile.mkstemp(dir=dir, suffix=".lease.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaseGrant:
+    """One published ledger state: who holds which chips, at which epoch.
+
+    ``grants`` maps holder → a sorted tuple of chip ids.  ``reason`` is
+    forensic (what SLO reading drove the change); ``wall`` stamps when it
+    was published."""
+
+    epoch: int
+    grants: dict
+    wall: float
+    reason: str = ""
+
+    def chips(self, holder: str) -> tuple:
+        return tuple(self.grants.get(holder, ()))
+
+    def to_payload(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "grants": {h: list(c) for h, c in sorted(self.grants.items())},
+            "wall": self.wall,
+            "reason": self.reason,
+        }
+
+
+class LeaseLedger:
+    """The lease file pair on a heartbeat dir: single-writer publish
+    (the arbiter), any-reader poll, per-holder acks.
+
+    The ledger itself enforces only the mechanics (atomicity, epoch
+    monotonicity, ack bookkeeping); *policy* — who loses chips when —
+    lives in :class:`flextree_tpu.arbiter.PoolArbiter`."""
+
+    def __init__(self, dir: str):
+        self.dir = dir
+        os.makedirs(dir, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.dir, LEASE_FILE)
+
+    def _ack_path(self, holder: str) -> str:
+        return os.path.join(self.dir, _ACK_FMT.format(holder=holder))
+
+    # ---- writer side (the arbiter) ----------------------------------------
+
+    def publish(self, epoch: int, grants: dict, reason: str = "") -> LeaseGrant:
+        """Atomically publish a new ledger state.  Epochs must strictly
+        increase — a replayed or reordered publish is a protocol bug, not
+        a race to smooth over."""
+        cur = self.read()
+        if cur is not None and epoch <= cur.epoch:
+            raise ValueError(
+                f"lease epoch must increase: {epoch} <= published {cur.epoch}"
+            )
+        seen: dict = {}
+        for holder, chips in grants.items():
+            for c in chips:
+                if c in seen:
+                    raise ValueError(
+                        f"chip {c!r} granted to both {seen[c]!r} and "
+                        f"{holder!r} at epoch {epoch}"
+                    )
+                seen[c] = holder
+        grant = LeaseGrant(
+            epoch=int(epoch),
+            grants={h: tuple(sorted(c)) for h, c in grants.items()},
+            wall=_wall(),
+            reason=reason,
+        )
+        _atomic_write_json(self.dir, self.path, grant.to_payload())
+        return grant
+
+    # ---- reader side (every holder) ---------------------------------------
+
+    def read(self) -> LeaseGrant | None:
+        """The current ledger state (None before the first publish; a
+        torn/garbage file reads as None too — the replace discipline makes
+        that transient)."""
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+            return LeaseGrant(
+                epoch=int(doc["epoch"]),
+                grants={h: tuple(c) for h, c in doc["grants"].items()},
+                wall=float(doc.get("wall", 0.0)),
+                reason=str(doc.get("reason", "")),
+            )
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def ack(self, holder: str, epoch: int) -> None:
+        """Record that ``holder`` now runs under ``epoch``'s grant."""
+        _atomic_write_json(
+            self.dir,
+            self._ack_path(holder),
+            {"holder": holder, "epoch": int(epoch), "wall": _wall()},
+        )
+
+    def acked_epoch(self, holder: str) -> int:
+        """The newest epoch ``holder`` acknowledged (-1: never acked)."""
+        try:
+            with open(self._ack_path(holder), encoding="utf-8") as f:
+                return int(json.load(f)["epoch"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return -1
+
+
+@dataclasses.dataclass(frozen=True)
+class ResizeDirective:
+    """A grant change training has not applied yet: the new chip set and
+    the ledger epoch to acknowledge once the rebuild lands."""
+
+    epoch: int
+    chips: tuple
+    reason: str = ""
+
+    @property
+    def n(self) -> int:
+        return len(self.chips)
+
+
+class TrainLeaseClient:
+    """Training's lease handle — what ``fit(arbiter=...)`` polls.
+
+    ``on_resize(chips, plan)`` is the rebuild hook, the resize twin of
+    ``Supervision.on_shrink``: return ``None`` to keep the current step
+    (world-size-agnostic steps), a ``(step_fn, mesh, state_specs)``
+    3-tuple, or the re-shard path's 5-tuple with checkpoint-layout
+    converters for the new world.  ``configured`` is the full-inventory
+    grant size (prices the replan; defaults to the largest grant seen).
+
+    The client is deliberately dumb: it reports grant CHANGES and acks
+    what the loop applied.  All sequencing safety lives in the ledger
+    handshake — the arbiter cannot hand our revoked chips to serving
+    until our ack exists, so a slow rebuild stretches the handoff instead
+    of racing it.
+    """
+
+    def __init__(
+        self,
+        ledger: LeaseLedger,
+        *,
+        holder: str = TRAIN,
+        on_resize: Callable | None = None,
+        initial_chips=None,
+        configured: int | None = None,
+        nbytes_hint: int = 4 << 20,
+        poll_interval_s: float = 0.2,
+        _mono=time.monotonic,
+    ):
+        self.ledger = ledger
+        self.holder = holder
+        self.on_resize = on_resize
+        self.configured = configured
+        self.nbytes_hint = nbytes_hint
+        self.poll_interval_s = float(poll_interval_s)
+        self._mono = _mono
+        self._next_poll = 0.0
+        self._applied_epoch = -1
+        # the grant the step was BUILT for.  Pass it whenever you know it
+        # (the builders do): with it, a first poll that reads a smaller
+        # grant — an early revocation, or a restart mid-handoff against
+        # the persistent heartbeat dir — is a resize directive like any
+        # other.  Without it, the first observation is trusted as the
+        # build world (convenience for tests and single-epoch runs).
+        self._chips: tuple | None = (
+            tuple(sorted(initial_chips)) if initial_chips is not None
+            else None
+        )
+
+    def poll(self, step: int) -> ResizeDirective | None:
+        """A pending grant change, or None.  Throttled file read; an
+        epoch whose chip set matches what we already run is acked in
+        place (e.g. the publish that granted OUR former chips to serving
+        — our slice did not change again)."""
+        now = self._mono()
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + self.poll_interval_s
+        grant = self.ledger.read()
+        if grant is None or grant.epoch <= self._applied_epoch:
+            return None
+        chips = grant.chips(self.holder)
+        if self._chips is None:
+            # first observation: adopt the current grant as the world we
+            # were built for (the builder sized the mesh from it)
+            self._adopt(grant.epoch, chips)
+            return None
+        if chips == self._chips:
+            self._adopt(grant.epoch, chips)  # epoch moved, our slice didn't
+            return None
+        if self.configured is not None:
+            self.configured = max(self.configured, len(chips))
+        return ResizeDirective(
+            epoch=grant.epoch, chips=chips, reason=grant.reason
+        )
+
+    def _adopt(self, epoch: int, chips: tuple) -> None:
+        self._applied_epoch = epoch
+        self._chips = chips
+        if self.configured is None or len(chips) > self.configured:
+            self.configured = len(chips)
+        self.ledger.ack(self.holder, epoch)
+
+    def ack(self, directive: ResizeDirective) -> None:
+        """The loop applied ``directive`` (checkpointed, rebuilt,
+        restored): acknowledge the epoch so the arbiter may hand the
+        revoked chips on."""
+        self._adopt(directive.epoch, directive.chips)
+
+    @property
+    def chips(self) -> tuple:
+        return self._chips or ()

@@ -112,6 +112,7 @@ class ServingEngine:
         metrics: MetricsRegistry | None = None,
         fused: bool = True,
         decode_impl: str = "jnp",
+        slo_window_s: float = 10.0,
     ):
         self.params = params
         self.cfg = cfg
@@ -124,6 +125,15 @@ class ServingEngine:
         # over the same counters); per-request timestamps stay on
         # CompletedRequest, the registry carries the aggregates
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # TTFT carries the SLO, so it is the WINDOWED histogram: the
+        # cumulative view dilutes a fresh breach after a quiet hour, the
+        # rolling window over slo_window_s is what engine.report() shows
+        # AND what the pool arbiter's breach check reads — one instrument,
+        # created here so no later plain histogram() call can shadow it
+        self.slo_window_s = float(slo_window_s)
+        self.metrics.windowed_histogram(
+            "serve.ttft_ms", interval_s=self.slo_window_s / 10.0, intervals=10
+        )
         self.batcher = ContinuousBatcher(pcfg, self.bcfg)
         self.pools = init_pools(cfg, pcfg)
         # donation keeps steady-state decode allocation-free: the pool

@@ -44,6 +44,7 @@ not a redesign — the named follow-up in ROADMAP.md.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 
@@ -78,7 +79,14 @@ class ReplicaFailed(RuntimeError):
 class PoolConfig:
     """``heartbeat_dir`` is the shared beat directory; lease/straggler
     budgets mirror :class:`~flextree_tpu.runtime.SupervisorConfig`.
-    ``step_timeout_s=None`` disables the watchdog (steps run inline)."""
+    ``step_timeout_s=None`` disables the watchdog (steps run inline).
+    ``parallel_rounds`` steps the live replicas on concurrent threads
+    instead of sequentially: each engine is still entered by exactly one
+    thread per round (the single-thread-per-engine contract holds), but
+    rounds overlap — XLA releases the GIL during execution, so on a
+    multi-core host N replicas buy real pooled throughput, not just N
+    queues.  Routing, harvest, and the reap stay on the caller's thread
+    either way."""
 
     heartbeat_dir: str
     step_timeout_s: float | None = None
@@ -86,6 +94,7 @@ class PoolConfig:
     straggler_s: float = 1.0
     lease_s: float = 3.0
     max_suspect_strikes: int = 3
+    parallel_rounds: bool = False
 
 
 class _Replica:
@@ -103,6 +112,7 @@ class _Replica:
         ).start()
         self.watchdog = StepWatchdog()
         self.alive = True
+        self.released = False  # arbiter-controlled graceful removal
         self.strikes = 0
         self.rounds = 0
         self.assigned: dict = {}  # rid -> Request (the re-route copy)
@@ -135,7 +145,10 @@ class ReplicaPool:
 
     ``engines`` are pre-built replicas (their pool/slot configs may
     differ); the pool owns routing, supervision, drain, and the
-    once-per-rid completion record.
+    once-per-rid completion record.  Membership is elastic under arbiter
+    control: :meth:`add_replica` joins a warmed engine mid-flight (burst
+    spin-up) and :meth:`release_replica` gracefully drains one back out
+    (chips returned to training) — docs/ARBITER.md.
     """
 
     def __init__(self, engines, cfg: PoolConfig):
@@ -188,13 +201,76 @@ class ReplicaPool:
 
     @property
     def degraded(self) -> bool:
-        return any(not r.alive for r in self.replicas)
+        # a RELEASED replica is capacity the arbiter took back on purpose,
+        # not a degradation — only deaths count
+        return any(not r.alive and not r.released for r in self.replicas)
 
     @property
     def idle(self) -> bool:
         return not self.queue and all(
             r.engine.idle for r in self.alive_replicas
         )
+
+    # ---- elastic membership (arbiter control) ------------------------------
+
+    def add_replica(self, engine: ServingEngine) -> int:
+        """Join a (warmed) engine to the pool as a new replica — the
+        arbiter's burst spin-up.  The replica starts heartbeating
+        immediately and is routable from the next ``step()``; warm the
+        engine BEFORE adding it, or the first routed requests eat its
+        compiles."""
+        rank = len(self.replicas)
+        self.replicas.append(_Replica(rank, engine, self.cfg))
+        self.metrics.counter("pool.replica_adds").inc()
+        self.metrics.gauge("pool.alive").set(len(self.alive_replicas))
+        record_event("replica_add", replica=rank)
+        log.info("replica %d joined the pool (%d alive)",
+                 rank, len(self.alive_replicas))
+        return rank
+
+    def release_replica(self, rank: int) -> list:
+        """Gracefully remove a replica — the arbiter's drain-on-return.
+
+        Unlike :meth:`_drain` (the DEATH path) this is planned: the same
+        harvest + exactly-once re-route of in-flight requests, but no
+        forensic dump and no degradation mark — released capacity is the
+        arbiter taking chips back, not a failure.  The engine is never
+        stepped again.  Returns the re-routed request ids."""
+        r = self.replicas[rank]
+        if not r.alive:
+            return []
+        lost = self._remove(r, released=True)
+        self.metrics.counter("pool.releases").inc()
+        record_event(
+            "replica_release", replica=rank,
+            rerouted=[q.rid for q in lost],
+            survivors=len(self.alive_replicas),
+        )
+        log.info(
+            "replica %d released: %d in-flight requests re-routed to %d "
+            "survivors", rank, len(lost), len(self.alive_replicas),
+        )
+        return [q.rid for q in lost]
+
+    def _remove(self, r: _Replica, *, released: bool) -> list:
+        """The shared removal body for BOTH exits (death drain / planned
+        release): stop the heartbeat, harvest completions that raced in
+        (dict reads are GIL-atomic; the engine itself is never
+        re-entered), and re-queue the rest for exactly-once re-routing
+        (greedy recompute is bit-identical).  Returns the lost requests."""
+        r.alive = False
+        r.released = released
+        r.supervisor.stop()
+        self._harvest(r)
+        lost = [
+            req for rid, req in r.assigned.items()
+            if rid not in self.completed
+        ]
+        for req in lost:
+            self.queue.append(req)
+        self.metrics.counter("pool.reroutes").inc(len(lost))
+        self.metrics.gauge("pool.alive").set(len(self.alive_replicas))
+        return lost
 
     # ---- chaos hook --------------------------------------------------------
 
@@ -251,8 +327,10 @@ class ReplicaPool:
 
     def step(self) -> None:
         """One pool round: route, step every live replica under its
-        watchdog, harvest completions, reap the dead."""
+        watchdog (sequentially, or concurrently with
+        ``parallel_rounds``), harvest completions, reap the dead."""
         self._route()
+        stepping = []
         for r in self.alive_replicas:
             if r.strikes > 0:
                 # suspect: the abandoned watchdog worker may still be
@@ -260,19 +338,60 @@ class ReplicaPool:
                 # skipped round is a strike toward the grace limit
                 r.strikes += 1
                 continue
-            try:
-                r.step_once(self.cfg.step_timeout_s)
-            except StepTimeout:
-                r.strikes = 1
-                record_event("replica_suspect", replica=r.rank, why="timeout")
-                log.warning("replica %d round timed out; suspect", r.rank)
-            except ReplicaFailed:
-                r.strikes = self.cfg.max_suspect_strikes
-                record_event("replica_suspect", replica=r.rank, why="raise")
-                log.warning("replica %d raised; awaiting verdict", r.rank)
-            else:
-                self._harvest(r)
+            stepping.append(r)
+        if self.cfg.parallel_rounds and len(stepping) > 1:
+            outcomes = {}
+
+            def _run(rep):
+                try:
+                    rep.step_once(self.cfg.step_timeout_s)
+                except Exception as e:  # settled on the caller's thread
+                    outcomes[rep.rank] = e
+
+            threads = [
+                threading.Thread(
+                    target=_run, args=(r,), name=f"ft-pool-round-{r.rank}"
+                )
+                for r in stepping
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # an exception the suspect machinery doesn't model must
+            # PROPAGATE exactly as it would from the sequential loop —
+            # swallowing it would harvest a broken replica as healthy
+            unexpected = [
+                e for e in outcomes.values()
+                if not isinstance(e, (StepTimeout, ReplicaFailed))
+            ]
+            if unexpected:
+                raise unexpected[0]
+            for r in stepping:
+                self._settle(r, outcomes.get(r.rank))
+        else:
+            for r in stepping:
+                try:
+                    r.step_once(self.cfg.step_timeout_s)
+                except (StepTimeout, ReplicaFailed) as e:
+                    self._settle(r, e)
+                else:
+                    self._settle(r, None)
         self._reap()
+
+    def _settle(self, r: _Replica, exc) -> None:
+        """Classify one replica's round outcome (caller's thread — the
+        completed/strike bookkeeping is never touched concurrently)."""
+        if exc is None:
+            self._harvest(r)
+        elif isinstance(exc, StepTimeout):
+            r.strikes = 1
+            record_event("replica_suspect", replica=r.rank, why="timeout")
+            log.warning("replica %d round timed out; suspect", r.rank)
+        else:
+            r.strikes = self.cfg.max_suspect_strikes
+            record_event("replica_suspect", replica=r.rank, why="raise")
+            log.warning("replica %d raised; awaiting verdict", r.rank)
 
     def _harvest(self, r: _Replica) -> None:
         for rid, done in list(r.engine.completed.items()):
@@ -297,18 +416,7 @@ class ReplicaPool:
                 self._drain(r, "lease" if lease_dead else "strikes")
 
     def _drain(self, r: _Replica, why: str) -> None:
-        r.alive = False
-        r.supervisor.stop()
-        # completions that raced in before death still count (dict reads
-        # are GIL-atomic; the engine itself is never re-entered)
-        self._harvest(r)
-        lost = [
-            req for rid, req in r.assigned.items()
-            if rid not in self.completed
-        ]
-        for req in lost:
-            self.queue.append(req)
-        self.metrics.counter("pool.reroutes").inc(len(lost))
+        lost = self._remove(r, released=False)
         self.metrics.counter("pool.drains").inc()
         record_event(
             "drain", replica=r.rank, why=why, rerouted=[q.rid for q in lost],
@@ -344,6 +452,7 @@ class ReplicaPool:
         return {
             "replicas": len(self.replicas),
             "alive": len(self.alive_replicas),
+            "released": sum(1 for r in self.replicas if r.released),
             "degraded": self.degraded,
             "submitted": self.submitted,
             "completed": len(self.completed),

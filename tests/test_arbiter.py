@@ -1,0 +1,853 @@
+"""Tier-1 coverage for the elastic device pool (ISSUE 13, docs/ARBITER.md):
+windowed SLO percentiles, the chip-lease protocol on the heartbeat dir,
+the arbiter's breach/hysteresis/cooldown state machine, ``fit``'s
+checkpoint → rebuild → restore lease resizes with the bitwise-resume
+proof, and the serving pool's arbiter-controlled add/release membership.
+
+Everything here is deterministic: clocks are injected (``metrics._now``,
+``arbiter.core._wall``, the lease client's ``_mono``), SLO readings are
+scripted, and the only JAX in the file is the tiny serving model the
+pool tests share.  The executed real-wall-clock proof is
+``tools/arbiter_spike.py`` → the committed ``ARBITER_SPIKE.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from flextree_tpu.arbiter import (
+    ArbiterConfig,
+    DeviceInventory,
+    PoolArbiter,
+    SloReading,
+    pool_slo_reader,
+)
+from flextree_tpu.obs.metrics import (
+    Histogram,
+    MetricsRegistry,
+    WindowedHistogram,
+    merged_window_percentile,
+)
+from flextree_tpu.obs.timeline import merge_events, validate_trace
+from flextree_tpu.parallel.loop import FitConfig, fit
+from flextree_tpu.runtime import (
+    LeaseGrant,
+    LeaseLedger,
+    ResizeDirective,
+    TrainLeaseClient,
+)
+
+# ------------------------------------------------------ windowed histograms
+
+
+class TestWindowedHistogram:
+    def test_window_answers_recent_cumulative_answers_everything(self):
+        h = WindowedHistogram(interval_s=1.0, intervals=5)
+        for i in range(2000):
+            h.observe(5.0, now=100.0 + i * 0.01)  # a long quiet run
+        for _ in range(10):
+            h.observe(5000.0, now=200.0)  # the fresh breach: 0.5% of total
+        # cumulative p99 is diluted by the quiet run; the window is not
+        assert h.percentile(99) < 100.0
+        assert h.window_percentile(99, now=200.0) > 1_000.0
+        assert h.window_count(now=200.0) == 10
+        assert h.count == 2010
+
+    def test_old_intervals_expire(self):
+        h = WindowedHistogram(interval_s=1.0, intervals=4)
+        h.observe(7.0, now=10.0)
+        assert h.window_count(now=10.0) == 1
+        assert h.window_count(now=13.9) == 1  # still inside the window
+        assert h.window_count(now=14.1) == 0  # aged out
+        assert math.isnan(h.window_percentile(99, now=14.1))
+        assert h.count == 1  # the cumulative view never forgets
+
+    def test_ring_slot_reuse_drops_stale_counts(self):
+        h = WindowedHistogram(interval_s=1.0, intervals=3)
+        h.observe(1.0, now=0.5)
+        # interval index 3 reuses slot 0; the old count must not bleed in
+        h.observe(2.0, now=3.5)
+        counts, count, _, mn, mx = h.window_counts(now=3.5)
+        assert count == 1 and mn == 2.0 and mx == 2.0
+
+    @pytest.mark.parametrize("dist", ["uniform", "lognormal"])
+    def test_window_percentile_vs_numpy_oracle(self, dist):
+        """The windowed percentile carries the same within-one-bucket
+        bound as the cumulative one, measured against NumPy over exactly
+        the in-window samples."""
+        rng = np.random.default_rng(hash(dist) % (2**32))
+        h = WindowedHistogram(interval_s=1.0, intervals=10)
+        old = rng.uniform(2_000, 9_000, 500)  # out-of-window noise
+        for v in old:
+            h.observe(v, now=50.0)
+        vals = {
+            "uniform": rng.uniform(0, 90, 4000),
+            "lognormal": rng.lognormal(1.0, 1.0, 4000),
+        }[dist]
+        t0 = 100.0
+        for i, v in enumerate(vals):
+            h.observe(v, now=t0 + (i % 10) * 0.9)
+        edges = (0.0,) + h.edges
+        for q in (50, 90, 95, 99):
+            got = h.window_percentile(q, now=t0 + 9.5)
+            want = float(np.percentile(vals, q))
+            i = int(np.searchsorted(h.edges, want))
+            lo = edges[i]
+            hi = h.edges[i] if i < len(h.edges) else float(np.max(vals))
+            assert abs(got - want) <= (hi - lo) + 1e-9, (q, got, want)
+
+    def test_merged_window_percentile_pools_replicas(self):
+        a = WindowedHistogram(interval_s=1.0, intervals=5)
+        b = WindowedHistogram(interval_s=1.0, intervals=5)
+        for _ in range(99):
+            a.observe(1.0, now=10.0)
+        b.observe(90_000.0, now=10.0)  # one replica hides the outlier...
+        assert a.window_percentile(99.5, now=10.0) <= 1.0
+        p, n = merged_window_percentile([a, b], 99.5, now=10.0)
+        assert n == 100
+        assert p > 1_000.0  # ...the pooled view does not
+
+    def test_merged_window_requires_matching_edges(self):
+        a = WindowedHistogram(buckets=(1.0, 2.0))
+        b = WindowedHistogram(buckets=(1.0, 3.0))
+        with pytest.raises(ValueError, match="bucket edges"):
+            merged_window_percentile([a, b], 99)
+        assert math.isnan(merged_window_percentile([], 99)[0])
+
+    def test_payload_carries_window_beside_cumulative(self):
+        h = WindowedHistogram(interval_s=1.0, intervals=5)
+        h.observe(3.0)
+        p = h.to_payload()
+        assert p["count"] == 1  # the cumulative schema is unchanged
+        assert p["window"]["seconds"] == 5.0
+        assert p["window"]["count"] == 1
+        json.dumps(p)
+
+    def test_registry_windowed_then_plain_is_one_instrument(self):
+        reg = MetricsRegistry()
+        w = reg.windowed_histogram("serve.ttft_ms", interval_s=0.5,
+                                   intervals=4)
+        assert reg.histogram("serve.ttft_ms") is w  # a windowed IS a plain
+        # ...but a plain one can never be upgraded in place
+        reg.histogram("other")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.windowed_histogram("other")
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="interval_s"):
+            WindowedHistogram(interval_s=0.0)
+        with pytest.raises(ValueError):
+            WindowedHistogram(intervals=0)
+
+
+# ------------------------------------------------------------- inventory
+
+
+class TestDeviceInventory:
+    def test_defaults_and_views(self):
+        inv = DeviceInventory([0, 1, 2, 3], train=(0, 1, 2))
+        assert inv.chips == (0, 1, 2, 3)
+        assert inv.held_by("train") == (0, 1, 2)
+        assert inv.held_by("serve") == (3,)
+        assert inv.grants() == {
+            "train": (0, 1, 2), "serve": (3,), "arbiter": ()
+        }
+
+    def test_move_is_all_or_nothing(self):
+        inv = DeviceInventory([0, 1, 2], train=(0, 1))
+        with pytest.raises(ValueError, match="held by"):
+            inv.move((1, 2), "train", "arbiter")  # 2 belongs to serve
+        assert inv.held_by("train") == (0, 1)  # nothing moved
+        inv.move((1,), "train", "arbiter")
+        assert inv.holder_of(1) == "arbiter"
+
+    def test_take_honors_the_keep_floor(self):
+        inv = DeviceInventory([0, 1, 2])
+        assert inv.take("train", 5, keep=1) == (1, 2)
+        assert inv.take("train", 1, keep=1) == ()  # already at the floor
+        assert inv.held_by("train") == (0,)
+
+    def test_bad_construction_is_loud(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            DeviceInventory([0, 0])
+        with pytest.raises(ValueError, match="unknown chips"):
+            DeviceInventory([0, 1], train=(7,))
+        with pytest.raises(ValueError, match="at least one"):
+            DeviceInventory([])
+        with pytest.raises(ValueError, match="not in the inventory"):
+            DeviceInventory([0]).holder_of(9)
+
+
+# ------------------------------------------------------------ lease ledger
+
+
+class TestLeaseLedger:
+    def test_publish_read_roundtrip(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        assert led.read() is None
+        led.publish(0, {"train": (0, 1), "serve": (2,)}, reason="initial")
+        grant = led.read()
+        assert isinstance(grant, LeaseGrant)
+        assert grant.epoch == 0 and grant.chips("train") == (0, 1)
+        assert grant.reason == "initial"
+
+    def test_epochs_must_increase(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        led.publish(3, {"train": (0,)})
+        with pytest.raises(ValueError, match="epoch must increase"):
+            led.publish(3, {"train": (0,)})
+
+    def test_double_granted_chip_is_loud(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        with pytest.raises(ValueError, match="granted to both"):
+            led.publish(0, {"train": (0, 1), "serve": (1,)})
+
+    def test_acks(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        assert led.acked_epoch("train") == -1
+        led.ack("train", 4)
+        assert led.acked_epoch("train") == 4
+        assert led.acked_epoch("serve") == -1
+
+    def test_garbage_ledger_reads_as_none(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        (tmp_path / "lease_ledger.json").write_text("{torn")
+        assert led.read() is None
+
+
+class TestTrainLeaseClient:
+    def _client(self, led, **kw):
+        clock = {"now": 0.0}
+        c = TrainLeaseClient(led, _mono=lambda: clock["now"],
+                             poll_interval_s=1.0, **kw)
+        return c, clock
+
+    def test_first_poll_adopts_and_acks(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        led.publish(0, {"train": (0, 1, 2)})
+        c, _ = self._client(led)
+        assert c.poll(0) is None
+        assert c.chips == (0, 1, 2)
+        assert led.acked_epoch("train") == 0
+
+    def test_changed_grant_is_a_directive_until_acked(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        led.publish(0, {"train": (0, 1, 2)})
+        c, clock = self._client(led)
+        c.poll(0)
+        led.publish(1, {"train": (0,), "arbiter": (1, 2)}, reason="breach")
+        clock["now"] = 1.0
+        d = c.poll(5)
+        assert d == ResizeDirective(epoch=1, chips=(0,), reason="breach")
+        assert led.acked_epoch("train") == 0  # not acked until applied
+        c.ack(d)
+        assert led.acked_epoch("train") == 1 and c.chips == (0,)
+
+    def test_unchanged_slice_acks_in_place(self, tmp_path):
+        """The epoch that hands OUR former chips to serving does not
+        change our slice: no resize, just an ack."""
+        led = LeaseLedger(str(tmp_path))
+        led.publish(0, {"train": (0,), "arbiter": (1,)})
+        c, clock = self._client(led)
+        c.poll(0)
+        led.publish(1, {"train": (0,), "serve": (1,)})
+        clock["now"] = 1.0
+        assert c.poll(3) is None
+        assert led.acked_epoch("train") == 1
+
+    def test_poll_is_throttled(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        led.publish(0, {"train": (0, 1)})
+        c, clock = self._client(led)
+        c.poll(0)
+        led.publish(1, {"train": (0,)})
+        assert c.poll(1) is None  # inside the poll interval: no file read
+        clock["now"] = 1.0
+        assert c.poll(2) is not None
+
+    def test_configured_tracks_largest_grant(self, tmp_path):
+        led = LeaseLedger(str(tmp_path))
+        led.publish(0, {"train": (0, 1, 2)})
+        c, _ = self._client(led)
+        c.poll(0)
+        assert c.configured == 3
+
+    def test_initial_chips_turns_a_first_poll_revocation_into_a_resize(
+        self, tmp_path
+    ):
+        """A client that KNOWS its build world must never silently ack a
+        revocation it hasn't applied — the first observation being a
+        smaller grant (early breach, restart mid-handoff) is a directive,
+        or the arbiter would hand chips to serving while training still
+        spans them."""
+        led = LeaseLedger(str(tmp_path))
+        led.publish(1, {"train": (0,), "arbiter": (1, 2)}, reason="breach")
+        c, _ = self._client(led, initial_chips=(0, 1, 2))
+        d = c.poll(0)
+        assert d == ResizeDirective(epoch=1, chips=(0,), reason="breach")
+        assert led.acked_epoch("train") == -1  # nothing acked yet
+
+
+# ----------------------------------------------------------- the arbiter
+
+
+def _mk_arbiter(tmp_path, monkeypatch, readings, cfg=None, **hooks):
+    """An arbiter over a scripted SLO feed and a fake wall clock; returns
+    (arbiter, clock, ledger, log) where log records hook calls."""
+    from flextree_tpu.arbiter import core as C
+
+    clock = {"now": 1000.0}
+    monkeypatch.setattr(C, "_wall", lambda: clock["now"])
+    inv = DeviceInventory([0, 1, 2, 3], train=(0, 1, 2))
+    led = LeaseLedger(str(tmp_path))
+    calls = {"grant": [], "return": []}
+    arb = PoolArbiter(
+        inv, led,
+        cfg or ArbiterConfig(
+            slo_p99_ms=100.0, window_s=5.0, release_frac=0.5,
+            breach_ticks=2, clear_ticks=2, cooldown_s=3.0,
+            min_train_chips=1, burst_chips=2, min_samples=5,
+        ),
+        slo_reader=lambda: readings[0],
+        on_serve_grant=lambda c: calls["grant"].append(tuple(c)),
+        on_serve_return=lambda c: calls["return"].append(tuple(c)),
+        **hooks,
+    )
+    return arb, clock, led, calls
+
+
+BREACH = SloReading(p99_ms=800.0, samples=20)
+CLEAR = SloReading(p99_ms=20.0, samples=20)
+IN_BAND = SloReading(p99_ms=80.0, samples=20)  # under SLO, over low-water
+THIN = SloReading(p99_ms=9_000.0, samples=2)  # loud but unproven
+EMPTY = SloReading(p99_ms=float("nan"), samples=0)
+
+
+class TestPoolArbiter:
+    def test_breach_is_debounced_then_preempts(self, tmp_path, monkeypatch):
+        readings = [BREACH]
+        arb, clock, led, _ = _mk_arbiter(tmp_path, monkeypatch, readings)
+        assert arb.tick()["action"] is None  # one tick is not a trend
+        clock["now"] += 1
+        d = arb.tick()
+        assert d["action"] == "preempt"
+        assert arb.pending_handoff == (1, 2)
+        assert arb.inventory.held_by("train") == (0,)
+        assert led.read().chips("arbiter") == (1, 2)  # parked, not serving
+
+    def test_grant_waits_for_the_train_ack(self, tmp_path, monkeypatch):
+        readings = [BREACH]
+        arb, clock, led, calls = _mk_arbiter(tmp_path, monkeypatch, readings)
+        for _ in range(2):
+            clock["now"] += 1
+            arb.tick()
+        epoch = led.read().epoch
+        clock["now"] += 1
+        assert arb.tick()["action"] is None  # no ack yet: chips stay parked
+        assert not calls["grant"]
+        led.ack("train", epoch)
+        clock["now"] += 1
+        assert arb.tick()["action"] == "grant"
+        assert calls["grant"] == [(1, 2)]
+        assert arb.loaned == (1, 2)
+        assert led.read().chips("serve") == (1, 2, 3)
+
+    def _to_loaned(self, arb, clock, led):
+        for _ in range(2):
+            clock["now"] += 1
+            arb.tick()
+        led.ack("train", led.read().epoch)
+        clock["now"] += 1
+        arb.tick()
+        assert arb.loaned == (1, 2)
+
+    def test_return_needs_sustained_clear_past_cooldown(
+        self, tmp_path, monkeypatch
+    ):
+        readings = [BREACH]
+        arb, clock, led, calls = _mk_arbiter(tmp_path, monkeypatch, readings)
+        self._to_loaned(arb, clock, led)
+        readings[0] = CLEAR
+        clock["now"] += 0.5
+        arb.tick()
+        clock["now"] += 0.5
+        assert arb.tick()["action"] is None  # clear_ticks met, cooldown not
+        clock["now"] += 5.0
+        d = arb.tick()
+        assert d["action"] == "return"
+        assert calls["return"] == [(1, 2)]
+        assert arb.inventory.held_by("train") == (0, 1, 2)
+        assert arb.loaned == ()
+        # training applies the return grant like any other epoch
+        assert led.read().chips("train") == (0, 1, 2)
+
+    def test_hysteresis_band_holds_the_allocation(self, tmp_path, monkeypatch):
+        """p99 under the SLO but over the low-water: neither streak
+        advances, chips stay where they are — the band IS the
+        anti-thrash."""
+        readings = [BREACH]
+        arb, clock, led, calls = _mk_arbiter(tmp_path, monkeypatch, readings)
+        self._to_loaned(arb, clock, led)
+        readings[0] = IN_BAND
+        for _ in range(20):
+            clock["now"] += 1
+            assert arb.tick()["action"] is None
+        assert arb.loaned == (1, 2)
+        assert not calls["return"]
+
+    def test_thin_window_is_no_evidence(self, tmp_path, monkeypatch):
+        readings = [THIN]
+        arb, clock, _, _ = _mk_arbiter(tmp_path, monkeypatch, readings)
+        for _ in range(5):
+            clock["now"] += 1
+            d = arb.tick()
+            assert d["action"] is None and not d["breached"]
+
+    def test_empty_window_clears(self, tmp_path, monkeypatch):
+        readings = [BREACH]
+        arb, clock, led, _ = _mk_arbiter(tmp_path, monkeypatch, readings)
+        self._to_loaned(arb, clock, led)
+        readings[0] = EMPTY  # traffic stopped entirely
+        clock["now"] += 4
+        arb.tick()
+        clock["now"] += 1
+        assert arb.tick()["action"] == "return"
+
+    def test_cooldown_blocks_immediate_re_preempt(self, tmp_path, monkeypatch):
+        """A spike right after a return must wait out the cooldown: a
+        single oscillation cannot thrash the pool."""
+        readings = [BREACH]
+        arb, clock, led, calls = _mk_arbiter(tmp_path, monkeypatch, readings)
+        self._to_loaned(arb, clock, led)
+        readings[0] = CLEAR
+        clock["now"] += 4
+        arb.tick()
+        clock["now"] += 1
+        assert arb.tick()["action"] == "return"
+        readings[0] = BREACH
+        clock["now"] += 1
+        arb.tick()
+        clock["now"] += 1
+        assert arb.tick()["action"] is None  # breach_ticks met, cooldown not
+        clock["now"] += 3
+        assert arb.tick()["action"] == "preempt"
+
+    def test_min_train_chips_floors_the_revocation(self, tmp_path, monkeypatch):
+        readings = [BREACH]
+        arb, clock, led, _ = _mk_arbiter(tmp_path, monkeypatch, readings)
+        self._to_loaned(arb, clock, led)  # train down to its 1-chip floor
+        readings[0] = BREACH
+        clock["now"] += 10
+        for _ in range(3):
+            clock["now"] += 1
+            assert arb.tick()["action"] is None  # nothing left to take
+        assert arb.inventory.held_by("train") == (0,)
+
+    def test_admit_blocked_growth_is_a_breach(self, tmp_path, monkeypatch):
+        readings = [SloReading(p99_ms=10.0, samples=20, admit_blocked=0.0)]
+        arb, clock, _, _ = _mk_arbiter(
+            tmp_path, monkeypatch, readings,
+            cfg=ArbiterConfig(
+                slo_p99_ms=100.0, breach_ticks=2, cooldown_s=0.5,
+                admit_blocked_delta=5.0, min_samples=5,
+            ),
+        )
+        arb.tick()
+        readings[0] = SloReading(p99_ms=10.0, samples=20, admit_blocked=10.0)
+        clock["now"] += 1
+        assert arb.tick()["breached"]  # p99 fine, admission pressure not
+        readings[0] = SloReading(p99_ms=10.0, samples=20, admit_blocked=20.0)
+        clock["now"] += 1
+        assert arb.tick()["action"] == "preempt"
+
+    def test_grant_restarts_the_cooldown(self, tmp_path, monkeypatch):
+        """The grant completes a chip move: a burst that ended while the
+        trainer was still checkpointing must not bounce the chips back on
+        the very next tick."""
+        readings = [BREACH]
+        arb, clock, led, _ = _mk_arbiter(tmp_path, monkeypatch, readings)
+        for _ in range(2):
+            clock["now"] += 1
+            arb.tick()
+        # the burst drains while training is still rebuilding (no ack):
+        # the clear streak fills during the pending handoff
+        readings[0] = CLEAR
+        for _ in range(3):
+            clock["now"] += 1
+            assert arb.tick()["action"] is None
+        led.ack("train", led.read().epoch)
+        clock["now"] += 1
+        assert arb.tick()["action"] == "grant"
+        grant_wall = clock["now"]
+        clock["now"] += 1
+        assert arb.tick()["action"] is None  # inside the post-grant cooldown
+        clock["now"] = grant_wall + 3.5  # past cooldown_s=3.0
+        assert arb.tick()["action"] == "return"
+
+    def test_restart_supersedes_a_prior_ledger(self, tmp_path, monkeypatch):
+        readings = [CLEAR]
+        arb1, clock, led, _ = _mk_arbiter(tmp_path, monkeypatch, readings)
+        assert led.read().epoch == 0
+        # a new arbiter against the same heartbeat dir must come up and
+        # keep epochs increasing, not refuse until the file is deleted
+        inv2 = DeviceInventory([0, 1, 2, 3], train=(0, 1, 2))
+        arb2 = PoolArbiter(
+            inv2, led,
+            ArbiterConfig(slo_p99_ms=100.0),
+            slo_reader=lambda: readings[0],
+        )
+        assert led.read().epoch == 1
+        assert led.read().chips("train") == (0, 1, 2)
+
+    def test_pool_slo_reader_enforces_the_window_match(self):
+        class _Eng:
+            def __init__(self):
+                self.metrics = MetricsRegistry()
+
+        class _Rep:
+            alive = True
+            rank = 0
+
+            def __init__(self):
+                self.engine = _Eng()
+
+        class _Pool:
+            replicas = [_Rep()]
+
+        pool = _Pool()
+        pool.replicas[0].engine.metrics.windowed_histogram(
+            "serve.ttft_ms", interval_s=1.0, intervals=10  # spans 10 s
+        )
+        with pytest.raises(ValueError, match="lease window"):
+            pool_slo_reader(pool, window_s=6.0)()
+        assert pool_slo_reader(pool, window_s=10.0)().samples == 0
+
+    def test_pool_slo_reader_merges_alive_replicas(self):
+        class _Eng:
+            def __init__(self):
+                self.metrics = MetricsRegistry()
+
+        class _Rep:
+            def __init__(self, alive):
+                self.alive = alive
+                self.engine = _Eng()
+
+        class _Pool:
+            replicas = [_Rep(True), _Rep(True), _Rep(False)]
+
+        pool = _Pool()
+        for i, r in enumerate(pool.replicas):
+            h = r.engine.metrics.windowed_histogram(
+                "serve.ttft_ms", interval_s=1.0, intervals=10
+            )
+            h.observe(10_000.0 if i > 0 else 1.0)
+            r.engine.metrics.counter("serve.admit_blocked").inc(3)
+        reading = pool_slo_reader(pool)()
+        assert reading.samples == 2  # the dead replica's window is gone
+        assert reading.p99_ms > 1_000.0
+        assert reading.admit_blocked == 6.0
+
+
+# ------------------------------------------------- fit + the lease client
+
+
+class _ToyData:
+    def batch_at(self, step):
+        tok = np.full((2, 4), float(step + 1))
+        return tok, tok
+
+
+def _toy_step(state, tokens, targets):
+    s = int(np.asarray(state["step"]))
+    return (
+        {"step": np.int64(s + 1),
+         "w": np.asarray(state["w"]) - 0.01 * float(tokens.mean())},
+        {"loss": float(tokens.mean())},
+    )
+
+
+def _w0():
+    return {"step": np.int64(0), "w": np.zeros(4, dtype=np.float64)}
+
+
+class TestFitLeaseResize:
+    def _scripted_client(self, led, script):
+        """A TrainLeaseClient whose ledger is mutated by `script` keyed on
+        the polling step — the in-process stand-in for the arbiter."""
+        client = TrainLeaseClient(led, poll_interval_s=0.0)
+        orig = client.poll
+
+        def poll(step):
+            for at, (epoch, grants) in list(script.items()):
+                if step >= at:
+                    led.publish(epoch, grants)
+                    del script[at]
+            return orig(step)
+
+        client.poll = poll
+        return client
+
+    def test_shrink_expand_cycle_is_bitwise_and_loses_no_steps(self, tmp_path):
+        led = LeaseLedger(str(tmp_path / "hb"))
+        led.publish(0, {"train": (0, 1, 2), "serve": (3,)})
+        client = self._scripted_client(led, {
+            4: (1, {"train": (0,), "arbiter": (1, 2), "serve": (3,)}),
+            8: (2, {"train": (0, 1, 2), "serve": (3,)}),
+        })
+        seen = []
+        client.on_resize = (
+            lambda chips, plan: seen.append((chips, plan.to_ft_topo())) or None
+        )
+        ck = str(tmp_path / "ck")
+        res = fit(
+            _w0(), _toy_step, _ToyData(),
+            FitConfig(num_steps=12, ckpt_dir=ck, ckpt_every=100,
+                      log_every=0, prefetch=0),
+            arbiter=client,
+        )
+        assert res.steps_run == 12  # zero lost steps
+        epochs = res.report.lease_epochs
+        assert [e["epoch"] for e in epochs] == [1, 2]
+        assert [len(e["chips"]) for e in epochs] == [1, 3]
+        assert all(e["bitwise_resume"] for e in epochs)
+        assert [c for c, _ in seen] == [(0,), (0, 1, 2)]
+        assert led.acked_epoch("train") == 2
+        # the arbitrated run ends bitwise equal to an undisturbed one
+        oracle = fit(_w0(), _toy_step, _ToyData(),
+                     FitConfig(num_steps=12, log_every=0, prefetch=0))
+        assert (np.asarray(res.state["w"]).tobytes()
+                == np.asarray(oracle.state["w"]).tobytes())
+
+    def test_resize_without_ckpt_dir_converts_the_live_state(self, tmp_path):
+        led = LeaseLedger(str(tmp_path / "hb"))
+        led.publish(0, {"train": (0, 1)})
+        client = self._scripted_client(
+            led, {3: (1, {"train": (0,), "arbiter": (1,)})}
+        )
+        res = fit(
+            _w0(), _toy_step, _ToyData(),
+            FitConfig(num_steps=6, log_every=0, prefetch=0),
+            arbiter=client,
+        )
+        assert res.steps_run == 6
+        assert [e["bitwise_resume"] for e in res.report.lease_epochs] == [True]
+
+    def test_zero_chip_grant_is_refused_loudly(self, tmp_path):
+        led = LeaseLedger(str(tmp_path / "hb"))
+        led.publish(0, {"train": (0,)})
+        client = self._scripted_client(
+            led, {2: (1, {"arbiter": (0,)})}
+        )
+        with pytest.raises(ValueError, match="zero chips"):
+            fit(
+                _w0(), _toy_step, _ToyData(),
+                FitConfig(num_steps=6, log_every=0, prefetch=0),
+                arbiter=client,
+            )
+
+    def test_run_report_serializes_lease_epochs(self, tmp_path):
+        led = LeaseLedger(str(tmp_path / "hb"))
+        led.publish(0, {"train": (0, 1)})
+        client = self._scripted_client(
+            led, {2: (1, {"train": (0,), "arbiter": (1,)})}
+        )
+        ck = str(tmp_path / "ck")
+        fit(
+            _w0(), _toy_step, _ToyData(),
+            FitConfig(num_steps=5, ckpt_dir=ck, log_every=0, prefetch=0),
+            arbiter=client,
+        )
+        with open(tmp_path / "ck" / "run_report.json") as f:
+            persisted = json.load(f)
+        assert persisted["lease_epochs"][0]["bitwise_resume"] is True
+
+
+# ----------------------------------------------- timeline: the arbiter lane
+
+
+class TestArbiterTimeline:
+    def test_arbiter_kinds_render_on_their_own_lane(self):
+        evs = [
+            {"ts": 1.0, "rank": 0, "seq": 0, "src": "train",
+             "kind": "step_start", "step": 0},
+            {"ts": 1.1, "rank": 0, "seq": 1, "src": "train",
+             "kind": "step_end", "step": 0},
+            {"ts": 1.2, "rank": 0, "seq": 2, "src": "train",
+             "kind": "slo_breach", "p99_ms": 900.0, "slo_p99_ms": 100.0},
+            {"ts": 1.3, "rank": 0, "seq": 3, "src": "train",
+             "kind": "lease_preempt", "chips": [1, 2], "epoch": 1},
+            {"ts": 1.5, "rank": 0, "seq": 4, "src": "train",
+             "kind": "lease_grant", "chips": [1, 2], "epoch": 2},
+            {"ts": 1.6, "rank": 0, "seq": 5, "src": "train",
+             "kind": "lease_resize", "step": 4, "epoch": 1,
+             "bitwise_resume": True},
+            {"ts": 2.0, "rank": 0, "seq": 6, "src": "train",
+             "kind": "lease_return", "chips": [1, 2], "epoch": 3},
+        ]
+        doc = merge_events(evs)
+        assert validate_trace(doc) == []
+        lanes = {
+            e["name"]: e["tid"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "i" and e.get("cat") == "arbiter"
+        }
+        assert set(lanes) == {
+            "slo_breach", "lease_preempt", "lease_grant", "lease_resize",
+            "lease_return",
+        }
+        assert set(lanes.values()) == {2}  # the dedicated lane
+        thread_names = {
+            (e["pid"], e["tid"]): e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "thread_name"
+        }
+        assert thread_names[(0, 2)] == "arbiter"
+        # the SLO reading rides along for the postmortem
+        breach = next(e for e in doc["traceEvents"]
+                      if e["name"] == "slo_breach")
+        assert breach["args"]["p99_ms"] == 900.0
+
+
+# -------------------------------------------- pool add/release (needs JAX)
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from flextree_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64
+    )
+    return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _mk_engine(model):
+    from flextree_tpu.serving import (
+        BatcherConfig,
+        PagedCacheConfig,
+        ServingEngine,
+    )
+
+    cfg, params = model
+    pcfg = PagedCacheConfig(num_blocks=32, block_size=8, blocks_per_seq=6)
+    return ServingEngine(params, cfg, pcfg, BatcherConfig(slots=2),
+                         slo_window_s=4.0)
+
+
+def _reqs(n, max_new=12):
+    from flextree_tpu.serving import Request
+
+    rng = np.random.default_rng(3)
+    return [
+        Request(rid=i, prompt=rng.integers(0, 64, (4,)).astype(np.int32),
+                max_new_tokens=max_new)
+        for i in range(n)
+    ]
+
+
+class TestPoolElasticMembership:
+    def test_add_then_release_exactly_once(self, tmp_path, model):
+        from flextree_tpu.serving import PoolConfig, ReplicaPool
+
+        pool = ReplicaPool(
+            [_mk_engine(model)], PoolConfig(heartbeat_dir=str(tmp_path))
+        )
+        reqs = _reqs(8)
+        for r in reqs[:4]:
+            pool.submit(r)
+        pool.step()
+        assert pool.add_replica(_mk_engine(model)) == 1
+        for r in reqs[4:]:
+            pool.submit(r)
+        pool.step()
+        pool.step()
+        assert pool.replicas[1].assigned  # the new replica took load
+        rerouted = pool.release_replica(1)
+        assert rerouted  # mid-decode work went back to the queue
+        assert pool.replicas[1].released and not pool.replicas[1].alive
+        assert not pool.degraded  # a release is not a degradation
+        report = pool.run_until_idle()
+        assert report["completed"] == 8
+        assert report["released"] == 1 and report["alive"] == 1
+        assert not report["rejected"]
+        assert sorted(pool.completed) == list(range(8))  # exactly once
+        assert pool.reroutes == len(rerouted)
+        pool.shutdown()
+
+    def test_release_is_idempotent_and_routes_around(self, tmp_path, model):
+        from flextree_tpu.serving import PoolConfig, ReplicaPool
+
+        pool = ReplicaPool(
+            [_mk_engine(model), _mk_engine(model)],
+            PoolConfig(heartbeat_dir=str(tmp_path)),
+        )
+        assert pool.release_replica(1) == []
+        assert pool.release_replica(1) == []  # second release: no-op
+        for r in _reqs(3):
+            pool.submit(r)
+        pool.run_until_idle()
+        assert len(pool.completed) == 3
+        assert not pool.replicas[1].assigned  # never routed to
+        pool.shutdown()
+
+    def test_parallel_rounds_complete_and_survive_a_kill(
+        self, tmp_path, model
+    ):
+        from flextree_tpu.serving import PoolConfig, ReplicaPool
+
+        pool = ReplicaPool(
+            [_mk_engine(model), _mk_engine(model)],
+            PoolConfig(heartbeat_dir=str(tmp_path), parallel_rounds=True,
+                       step_timeout_s=10.0),
+        )
+        for r in _reqs(6):
+            pool.submit(r)
+        pool.step()
+        pool.kill(1, mode="raise")
+        report = pool.run_until_idle()
+        assert report["completed"] == 6  # degraded, not failed
+        assert report["degraded"] is True
+        assert sorted(pool.completed) == list(range(6))
+        pool.shutdown()
+
+    def test_parallel_rounds_propagate_unexpected_exceptions(
+        self, tmp_path, model
+    ):
+        """An exception the suspect machinery doesn't model (not a
+        timeout, not a ReplicaFailed) must propagate from the parallel
+        round exactly as it does from the sequential one — a swallowed
+        error would harvest a broken replica as healthy forever."""
+        from flextree_tpu.serving import PoolConfig, ReplicaPool
+
+        pool = ReplicaPool(
+            [_mk_engine(model), _mk_engine(model)],
+            PoolConfig(heartbeat_dir=str(tmp_path), parallel_rounds=True),
+        )
+        for r in _reqs(4):
+            pool.submit(r)
+
+        def broken_step():
+            raise ValueError("cache accounting bug")
+
+        pool.replicas[1].engine.step = broken_step
+        with pytest.raises(ValueError, match="cache accounting bug"):
+            pool.step()
+        pool.shutdown()
+
+    def test_engine_report_carries_the_ttft_window(self, tmp_path, model):
+        eng = _mk_engine(model)
+        eng.submit(_reqs(1, max_new=2)[0])
+        while not eng.idle:
+            eng.step()
+        payload = eng.report()["histograms"]["serve.ttft_ms"]
+        assert payload["count"] == 1
+        assert payload["window"]["seconds"] == 4.0

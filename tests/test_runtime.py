@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import threading
 import time
 
 import numpy as np
@@ -239,6 +240,95 @@ class TestBackgroundSaver:
         bs.submit({"no_step_key": np.ones(2)})  # save_train_state will raise
         bs.close()
         assert bs.saves == 0 and len(bs.errors) == 1
+
+    def test_second_preempt_before_first_save_lands_keeps_newest(
+        self, tmp_path, monkeypatch
+    ):
+        """Back-to-back preemption (ISSUE 13 satellite): a second
+        checkpoint-now submit arriving while the FIRST save is still
+        serializing must never lose the newer state — depth-1
+        latest-wins coalesces the middle one away and persists the
+        newest, and drain() reports busy until the slot truly empties."""
+        import flextree_tpu.utils.checkpoint as ckpt
+
+        real = ckpt.save_train_state
+        gate, started = threading.Event(), threading.Event()
+        landed = []
+
+        def gated_save(dir, state, **kw):
+            started.set()
+            assert gate.wait(10), "test gate never opened"
+            landed.append(int(np.asarray(state["step"])))
+            return real(dir, state, **kw)
+
+        # patch BEFORE constructing: the saver thread binds the symbol on
+        # its first loop entry
+        monkeypatch.setattr(ckpt, "save_train_state", gated_save)
+        bs = BackgroundSaver(tmp_path, max_to_keep=5)
+        bs.submit(self._state(5))  # the first SIGTERM's checkpoint
+        assert started.wait(10)
+        bs.submit(self._state(6))  # the second SIGTERM, save still in flight
+        bs.submit(self._state(7))  # ...and a third: only the newest matters
+        assert not bs.drain(timeout=0.2)  # slot busy: drain must say so
+        gate.set()
+        assert bs.drain(timeout=10)
+        bs.close()
+        steps = [s for s, _ in list_checkpoints(tmp_path)]
+        assert steps[-1] == 7, steps  # the NEWER state was never dropped
+        assert landed == [5, 7]  # 6 coalesced away (latest-wins, depth 1)
+        assert bs.saves == 2 and bs.dropped == 1
+
+    def test_preempt_drain_ordering_no_writer_overlap(
+        self, tmp_path, monkeypatch
+    ):
+        """The fit preemption fast path's drain ordering, pinned: its
+        synchronous checkpoint-now save must never start while a
+        background save is mid-flight (two writers racing the rotation
+        is the one thing the saver design forbids)."""
+        import flextree_tpu.utils.checkpoint as ckpt
+
+        real = ckpt.save_train_state
+        order = []
+
+        def tracked_save(dir, state, **kw):
+            me = threading.current_thread().name
+            order.append(("start", me))
+            if me == "ft-bg-ckpt":
+                time.sleep(0.25)  # a slow background serialization
+            out = real(dir, state, **kw)
+            order.append(("end", me))
+            return out
+
+        # two call sites, two bindings: the saver thread late-binds the
+        # checkpoint module's symbol, fit bound its own at import
+        monkeypatch.setattr(ckpt, "save_train_state", tracked_save)
+        import flextree_tpu.parallel.loop as loop_mod
+
+        monkeypatch.setattr(loop_mod, "save_train_state", tracked_save)
+        ck = str(tmp_path / "ck")
+        bs = BackgroundSaver(ck)
+        guard = PreemptionGuard()
+
+        def trigger_at_3(s):
+            if s == 3:  # "SIGTERM" lands while step 2's bg save is slow
+                guard.trigger()
+
+        res = fit(
+            _w0(), _toy_step(on_step=trigger_at_3), _ToyData(),
+            FitConfig(num_steps=20, ckpt_dir=ck, ckpt_every=2, log_every=0),
+            supervision=Supervision(preemption=guard, background_saver=bs),
+        )
+        bs.close()
+        assert res.report.preempted_at is not None
+        bg_open = 0
+        for kind, name in order:
+            if name == "ft-bg-ckpt":
+                bg_open += 1 if kind == "start" else -1
+            elif kind == "start":
+                assert bg_open == 0, (
+                    f"synchronous save started over an in-flight "
+                    f"background save: {order}"
+                )
 
 
 # -------------------------------------------------- fit + supervision
