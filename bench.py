@@ -915,6 +915,54 @@ def run_arbiter_tripwire(timeout_s: int = 600) -> dict:
             pass
 
 
+def run_coordination_tripwire(timeout_s: int = 600) -> dict:
+    """Supplementary key ``coordination_violations`` — the coordinated
+    elastic control plane exercised end-to-end on this exact tree
+    (ISSUE 14; 0 = a coordinator SIGKILL'd mid-handshake fails over and
+    the in-flight commit completes at the same epoch, an adversarial
+    torn-ledger scribbler never crashes or mis-applies a decision, and a
+    group-committed arbiter resize lands bitwise with the lease ack
+    fenced on the control epoch).
+
+    Runs ``tools/coord_chaos.py --smoke`` in a subprocess (3 real OS
+    processes per scenario, real signals; the full kill-at-every-phase ×
+    stall × gloo matrix lives in the committed COORD_CHAOS.json); a
+    driver that fails to run reports ``coordination_error`` with the key
+    absent — absent reads as "not verified", never as "clean".
+    """
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "coord_chaos.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        violations = sum(
+            0 if s.get("ok") else 1 for s in doc["scenarios"].values()
+        )
+        out = {"coordination_violations": violations}
+        if p.returncode != 0 and not violations:
+            # rc=1 WITH violations is the driver doing its job; rc!=0
+            # with a clean report means the driver itself malfunctioned
+            out["coordination_error"] = f"coord_chaos rc={p.returncode}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"coordination_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -988,6 +1036,7 @@ def main() -> int:
         result.update(run_obs_tripwire())
         result.update(run_feedback_tripwire())
         result.update(run_arbiter_tripwire())
+        result.update(run_coordination_tripwire())
     print(json.dumps(result))
     return 0
 

@@ -362,8 +362,20 @@ class PoolArbiter:
         """Phase 2: once training acked the revocation epoch, hand the
         parked chips to serving and fire the burst replicas."""
         pending = self._pending
-        if self.ledger.acked_epoch(TRAIN) < pending["epoch"]:
+        # ONE ack read serves both fields — two reads could pair the
+        # epoch from one ack version with the control stamp of another
+        ack = self.ledger.read_ack(TRAIN) or {}
+        try:
+            acked = int(ack["epoch"])
+        except (KeyError, ValueError, TypeError):
+            acked = -1
+        if acked < pending["epoch"]:
             return None  # trainer still checkpointing/rebuilding: wait
+        # a coordinated (multi-process) tenant stamps the control epoch it
+        # group-applied the revocation under (runtime.coordination's
+        # fencing: the ack provably post-dates the apply); single-process
+        # tenants leave it None — record whichever the ack carries
+        control_epoch = ack.get("control_epoch")
         chips = self.inventory.move(pending["chips"], ARBITER, SERVE)
         epoch = self._publish(f"granting {list(chips)} to serving")
         self._loaned.extend(chips)
@@ -377,6 +389,7 @@ class PoolArbiter:
             chips=list(chips),
             holder=SERVE,
             epoch=epoch,
+            control_epoch=control_epoch,
             **reading.to_payload(),
         )
         if self.on_serve_grant is not None:

@@ -24,6 +24,12 @@ Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``:
   dedicated **arbiter lane** with the SLO reading in their ``args``, so
   every chip reallocation is visible beside the train/serve spans it
   caused;
+- coordination-protocol events (``coord_propose``/``coord_ack``/
+  ``coord_commit``/``coord_repropose``/``coord_failover``/
+  ``coord_fence``/``coord_apply``, ``runtime/coordination.py``) render
+  on a dedicated **coordination lane**, so a merged trace shows which
+  rank proposed each control epoch, who acked late, where the commit
+  landed and who got fenced;
 - everything else is an instant event carrying its fields as ``args``.
 
 Timestamps are wall-clock (the recorders stamp with ``time.time`` for
@@ -62,6 +68,18 @@ _HEARTBEAT_KINDS = frozenset({"heartbeat"})
 _ARBITER_KINDS = frozenset(
     {"slo_breach", "lease_grant", "lease_preempt", "lease_return",
      "lease_resize"}
+)
+
+#: coordination-protocol kinds (runtime/coordination.py) rendered on their
+#: own lane (tid 3), the same pattern as the arbiter lane: a merged trace
+#: shows which rank proposed, who acked (and who acked late), where the
+#: commit landed, who took over after a coordinator death, and who got
+#: fenced — plus the control-plane health events (torn control files,
+#: wall-clock regressions) beside the decisions they endangered
+_COORD_KINDS = frozenset(
+    {"coord_propose", "coord_ack", "coord_commit", "coord_repropose",
+     "coord_failover", "coord_fence", "coord_apply", "coord_commit_race",
+     "torn_control_file", "clock_regression"}
 )
 
 #: paired-kind suffixes → complete events
@@ -151,6 +169,7 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
     trace: list[dict] = []
     ranks: dict[int, str] = {}
     arbiter_ranks: set = set()
+    coord_ranks: set = set()
     open_pairs: dict = {}
     flow_open: set = set()
 
@@ -162,6 +181,9 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
         if kind in _ARBITER_KINDS:
             tid = 2
             arbiter_ranks.add(rank)
+        elif kind in _COORD_KINDS:
+            tid = 3
+            coord_ranks.add(rank)
         common = {"pid": rank, "tid": tid, "ts": us(ev["ts"])}
 
         if kind.endswith(_START_SUFFIX):
@@ -249,6 +271,15 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
             )
             continue
 
+        if kind in _COORD_KINDS:
+            # handshake phases as process-scoped instants on the
+            # coordination lane: a control epoch concerns the whole rank
+            trace.append(
+                {"name": kind, "cat": "coordination", "ph": "i", "s": "p",
+                 **common, "args": _args(ev)}
+            )
+            continue
+
         scope = "p" if kind in ("dump", "shrink", "preempt") else "t"
         trace.append(
             {"name": kind, "cat": kind, "ph": "i", "s": scope, **common,
@@ -292,6 +323,11 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
             trace.append(
                 {"name": "thread_name", "ph": "M", "pid": rank, "tid": 2,
                  "args": {"name": "arbiter"}}
+            )
+        if rank in coord_ranks:
+            trace.append(
+                {"name": "thread_name", "ph": "M", "pid": rank, "tid": 3,
+                 "args": {"name": "coordination"}}
             )
 
     doc = {

@@ -153,6 +153,19 @@ class Supervision:
     recorder installed the per-step cost is one ``None`` check — the
     identical check ``record_event`` makes — so telemetry-off runs pay
     nothing.
+
+    ``coordination``: a ``runtime.CoordinationHandle`` — arms the
+    coordinated elastic control plane (docs/COORDINATION.md) for
+    multi-process groups.  Elastic decisions then stop being rank-local:
+    confirmed deaths make the group's *coordinator* (lowest-rank healthy
+    member) PROPOSE a shrink whose survivor set and replanned topology
+    every rank applies from the committed control epoch; the feedback
+    controller's drift refits propose group-wide replans the same way
+    (arm it with the same handle); and arbiter lease resizes ride the
+    identical commit path via ``TrainLeaseClient(coordination=...)``.
+    The loop calls ``gate(step)`` once per iteration; a rank excluded
+    from a committed epoch exits loudly with ``runtime.EpochFenced``
+    rather than training on a stale plan.
     """
 
     supervisor: Any = None
@@ -167,6 +180,7 @@ class Supervision:
     preemption: Any = None
     background_saver: Any = None
     feedback: Any = None
+    coordination: Any = None
 
 
 @dataclasses.dataclass
@@ -195,6 +209,11 @@ class RunReport:
     # membership epochs: entry 0 is the starting world, one more per live
     # shrink — {"step", "alive", "configured", "topo", "dead"}
     membership_epochs: list = dataclasses.field(default_factory=list)
+    # --- coordinated control plane (empty without a coordination handle) ---
+    # one entry per APPLIED committed control epoch — {"step", "epoch",
+    # "kind", "fingerprint"}: the per-rank audit the chaos floors compare
+    # (same final epoch + fingerprint on every survivor, no double-applies)
+    control_epochs: list = dataclasses.field(default_factory=list)
     preempted_at: int | None = None  # step the SIGTERM checkpoint ran at
     background_saves: int = 0  # off-step-path checkpoint writes
     # the ambient obs registry's snapshot (None when the run carried no
@@ -369,6 +388,12 @@ def fit(
         configured = max(getattr(arbiter, "configured", None) or n, n)
         nbytes = getattr(arbiter, "nbytes_hint", 4 << 20)
         plan = replan_for_survivors(n, nbytes, configured=configured)
+        if getattr(directive, "topo", None):
+            # a coordinated resize broadcasts the coordinator's plan —
+            # every rank must run IT, not its own chooser's winner
+            from ..runtime.coordination import apply_spec_override
+
+            plan = apply_spec_override(plan, directive.topo, n)
         log.warning(
             "lease resize at step %d: epoch %d grants chips %s (%d); "
             "replanned topo %s",
@@ -440,10 +465,12 @@ def fit(
     step_timeout = None
     world: int | None = None  # current epoch's alive count
     known_dead: set = set()
+    pending_dead: set = set()  # observed deaths awaiting a group decision
     flagged_stragglers: set = set()
     shrinks = 0
     timeout_retries = 0
     feedback_dead = False  # a tick raised: feedback disarmed for the run
+    coordn = sup.coordination if sup is not None else None
     if sup is not None:
         from ..runtime.watchdog import StepTimeout, StepWatchdog, step_timeout_from_env
 
@@ -497,17 +524,26 @@ def fit(
             # anyway, so this adds no extra host-device sync per step.)
             return jax.block_until_ready(cur_step_fn(st, tk, tg))
 
-        def _shrink(at_step, new_dead):
-            """Live shrink-to-survivors: drain, rebuild, restore, resume."""
+        def _shrink(at_step, new_dead, *, alive=None, plan=None):
+            """Live shrink-to-survivors: drain, rebuild, restore, resume.
+
+            ``alive``/``plan`` are the coordinated-broadcast overrides: a
+            committed group shrink carries the coordinator's survivor
+            count and replanned topology so every rank applies THE SAME
+            decision instead of each computing its own."""
             nonlocal state, world, shrinks, step, batches
             nonlocal cur_step_fn, cur_mesh, cur_specs, cur_pack, cur_unpack
             from ..planner.choose import replan_for_survivors
 
             prev_world = world
-            n_alive = max(1, world - len(new_dead))
-            plan = replan_for_survivors(
-                n_alive, sup.nbytes_hint, configured=prev_world
+            n_alive = (
+                int(alive) if alive is not None
+                else max(1, world - len(new_dead))
             )
+            if plan is None:
+                plan = replan_for_survivors(
+                    n_alive, sup.nbytes_hint, configured=prev_world
+                )
             log.warning(
                 "membership shrink at step %d: ranks %s dead, %d/%d alive; "
                 "replanned topo %s",
@@ -568,8 +604,13 @@ def fit(
                 configured=prev_world, topo=plan.to_ft_topo(),
             )
             # the forensic record of WHAT the survivor saw around the
-            # death: ring context + the shrink decision, guaranteed
-            dump_current("peer_shrink", step=at_step, dead=list(new_dead))
+            # death: ring context + the shrink decision, guaranteed —
+            # with the handshake phase attached when the shrink was a
+            # group decision (which phase the fault interrupted)
+            dump_current(
+                "peer_shrink", step=at_step, dead=list(new_dead),
+                **({"coord_phase": coordn.phase} if coordn is not None else {}),
+            )
             batches = _batches(step)
 
         def _membership_tick(at_step) -> str:
@@ -602,8 +643,152 @@ def fit(
                     f"ranks {new_dead} died at step {at_step} after "
                     f"{shrinks} shrink(s); max_shrinks={sup.max_shrinks}"
                 )
+            if coordn is not None:
+                # coordinated group: a local death observation is not
+                # authority.  Park it; the coordination gate below turns
+                # it into a propose→ack→commit group decision (this rank
+                # proposes only while it IS the coordinator), and the
+                # shrink applies when the committed epoch arrives.
+                pending_dead.update(new_dead)
+                return "ok"
             _shrink(at_step, new_dead)
             return "shrunk"
+
+        def _apply_committed(at_step, decision):
+            """Apply one committed group decision (the coordination gate's
+            output) and advance this rank's fence.  Every branch applies
+            EXACTLY what the commit carries — the local machinery only
+            executes, it never re-decides."""
+            nonlocal cur_step_fn, cur_mesh, cur_specs, cur_pack, cur_unpack
+            payload = decision.payload
+            if decision.kind == "shrink":
+                if shrinks >= sup.max_shrinks:
+                    raise ShrinkExhausted(
+                        f"committed shrink epoch {decision.epoch} at step "
+                        f"{at_step} after {shrinks} shrink(s); "
+                        f"max_shrinks={sup.max_shrinks}"
+                    )
+                from ..runtime.coordination import committed_shrink_plan
+
+                dead = [int(r) for r in payload.get("dead", ())]
+                known_dead.update(dead)
+                pending_dead.difference_update(dead)
+                plan = committed_shrink_plan(payload, sup.nbytes_hint)
+                _shrink(
+                    at_step, dead, alive=int(payload["alive"]), plan=plan
+                )
+            elif decision.kind == "replan":
+                if sup.feedback is not None:
+                    dec = sup.feedback.apply_committed(payload, step=at_step)
+                    report.feedback_refits += 1
+                    if dec.rebuilt is not None:
+                        (cur_step_fn, cur_mesh, cur_specs,
+                         cur_pack, cur_unpack) = _apply_rebuild(
+                             dec.rebuilt, cur_pack, cur_unpack)
+                        report.feedback_replans += 1
+                    record_event(
+                        "feedback_replan", step=at_step,
+                        topo=dec.plan.to_ft_topo(),
+                        invalidated=dec.invalidated,
+                        swapped=dec.rebuilt is not None,
+                        control_epoch=decision.epoch,
+                    )
+                else:
+                    # a committed replan this rank CANNOT execute: the
+                    # peers are swapping comm plans and we would keep the
+                    # old one — the exact split-brain the protocol
+                    # exists to prevent.  Loud exit, never silent
+                    # divergence (the fencing ethos).
+                    from ..runtime.coordination import ProtocolViolation
+
+                    raise ProtocolViolation(
+                        f"committed replan epoch {decision.epoch} but this "
+                        "rank has no feedback controller to apply it — arm "
+                        "Supervision.feedback with a coordinated "
+                        "FeedbackController on every rank, or on none"
+                    )
+            elif decision.kind == "resize":
+                if arbiter is not None:
+                    from ..runtime.leases import ResizeDirective
+
+                    _lease_resize(
+                        at_step,
+                        ResizeDirective(
+                            epoch=int(payload["lease_epoch"]),
+                            chips=tuple(payload.get("chips", ())),
+                            reason=str(payload.get("reason", "")),
+                            control_epoch=decision.epoch,
+                            topo=payload.get("topo"),
+                        ),
+                    )
+                else:
+                    from ..runtime.coordination import ProtocolViolation
+
+                    raise ProtocolViolation(
+                        f"committed resize epoch {decision.epoch} but this "
+                        "rank has no lease client — pass the coordinated "
+                        "TrainLeaseClient as fit(arbiter=...) on every rank"
+                    )
+            else:
+                from ..runtime.coordination import ProtocolViolation
+
+                raise ProtocolViolation(
+                    f"committed decision kind {decision.kind!r} (epoch "
+                    f"{decision.epoch}) is unknown to this rank — version "
+                    "skew across the group; refusing to train on a "
+                    "possibly-stale plan"
+                )
+            coordn.mark_applied(decision)
+            report.control_epochs.append(
+                {
+                    "step": at_step,
+                    "epoch": decision.epoch,
+                    "kind": decision.kind,
+                    "fingerprint": decision.fingerprint,
+                }
+            )
+
+        def _coordination_gate(at_step) -> bool:
+            """One control-plane tick: apply at most one committed
+            decision, else propose parked deaths (coordinator only).
+            True when a decision was applied (the loop re-enters: the
+            world/plan just changed under it).  Apply-before-propose +
+            the handle's refusal to propose over an unapplied commit
+            keep a parked death from double-proposing while its own
+            shrink is mid-delivery."""
+            decision = coordn.gate(at_step)
+            if decision is not None:
+                _apply_committed(at_step, decision)
+                return True
+            if (
+                pending_dead
+                and shrinks < sup.max_shrinks
+                and coordn.is_coordinator
+            ):
+                from ..planner.choose import replan_for_survivors
+
+                n_alive = max(1, (world or 1) - len(pending_dead))
+                plan = replan_for_survivors(
+                    n_alive, sup.nbytes_hint, configured=world
+                )
+                # None while another decision is mid-handshake — the
+                # parked deaths re-propose on a later tick
+                proposed = coordn.propose(
+                    "shrink",
+                    {
+                        "dead": sorted(pending_dead),
+                        "alive": n_alive,
+                        "configured": world,
+                        "topo": plan.to_ft_topo(),
+                    },
+                )
+                if proposed is not None:
+                    # the ledger now carries the survivor set (a dying
+                    # proposer's successor re-proposes from THERE): the
+                    # local parking is done; the apply path re-derives
+                    # the dead list from the committed payload
+                    pending_dead.clear()
+            return False
 
         # epoch 0: the starting world
         if sup.membership is not None or sup.configured_world:
@@ -651,6 +836,10 @@ def fit(
                     and step % max(1, sup.check_every) == 0
                     and _membership_tick(step) == "shrunk"
                 ):
+                    continue
+                if coordn is not None and _coordination_gate(step):
+                    # a committed group decision just applied (shrink /
+                    # replan / resize): re-enter the loop on the new world
                     continue
             if arbiter is not None:
                 # the arbiter moved chips: apply the grant before the next
@@ -853,6 +1042,9 @@ def fit(
             reg.counter("train.feedback_replans").inc(report.feedback_replans)
             reg.counter("train.feedback_refusals").inc(report.feedback_refusals)
             reg.counter("train.lease_resizes").inc(len(report.lease_epochs))
+            reg.counter("train.control_applies").inc(
+                len(report.control_epochs)
+            )
             reg.gauge("train.last_step").set(step)
             report.metrics = reg.snapshot()
         record_event("fit_end", id=start, step=step)

@@ -75,6 +75,8 @@ from ..utils.logging import get_logger
 from .autotune import invalidate_plan_cache
 from .calibrate import (
     MeasuredPoint,
+    _params_from_dict,
+    _params_to_dict,
     backend_fingerprint,
     default_params,
     feature_vector,
@@ -730,6 +732,7 @@ class FeedbackController:
         cfg: FeedbackConfig | None = None,
         *,
         params: TpuCostParams | None = None,
+        coordination=None,
         timer: Callable | None = None,
         clock: Callable[[], float] = time.perf_counter,
     ):
@@ -739,6 +742,13 @@ class FeedbackController:
         self.nbytes = int(nbytes)
         self.cfg = cfg or FeedbackConfig()
         self.params = params if params is not None else default_params()
+        # multi-process groups: drift refits become PROPOSE-only — the
+        # coordinator publishes the refitted constants + plan through the
+        # epoch-consensus protocol (runtime.coordination) and EVERY rank
+        # applies the committed decision via apply_committed(), lifting
+        # docs/FEEDBACK.md's "replans are rank-local" limit.  Probes stay
+        # local: only the coordinator's controller ticks.
+        self.coordination = coordination
         self._timer = timer
         self._clock = clock
         self._fingerprint = backend_fingerprint()
@@ -801,6 +811,14 @@ class FeedbackController:
             return None
         k = max(1, self.cfg.every_k)
         if step == 0 or step % k != 0 or step == self._last_step:
+            return None
+        if self.coordination is not None and not self.coordination.is_coordinator:
+            # coordinated follower: the refit+replan arrives as a
+            # committed group decision (fit's coordination gate →
+            # apply_committed); probing here would only burn wall time on
+            # a decision this rank has no authority to make.  Checked on
+            # the every_k cadence, not per step — is_coordinator polls
+            # the membership files.
             return None
         self._last_step = step
         return self.tick(step)
@@ -912,6 +930,8 @@ class FeedbackController:
             )
             log.warning("feedback refit refused at step %d: %s", step, e)
             return None
+        if self.coordination is not None:
+            return self._propose_replan(step, new_params, meta, drift)
         self.refits += 1
         if self.cfg.calibration_path:
             save_calibration(
@@ -965,6 +985,111 @@ class FeedbackController:
             else None
         )
         return ReplanDecision(plan, new_params, breaches, removed, meta, rebuilt)
+
+    # -- the coordinated (multi-process) replan path --------------------
+
+    def _propose_replan(
+        self, step: int, new_params: TpuCostParams, meta: dict, drift: dict
+    ) -> None:
+        """Publish the refit as a group decision instead of applying it.
+
+        The payload carries everything a peer needs to apply IDENTICALLY:
+        the refitted constants (serialized through the calibration
+        schema's dict form) and the topo spec the coordinator's chooser
+        picked under them — peers re-run ``choose_topology`` from the
+        same constants and assert the same winner.  The apply (for every
+        rank, this one included) happens in :meth:`apply_committed` when
+        ``fit``'s coordination gate delivers the commit."""
+        payload = {
+            "params": _params_to_dict(new_params),
+            "topo": choose_topology(
+                self.n, self.nbytes, params=new_params
+            ).to_ft_topo(),
+            "drift": drift,
+            "fit_meta": meta,
+            "samples": len(self.samples),
+        }
+        epoch = self.coordination.propose(
+            "replan",
+            payload,
+            apply_step=self.coordination.suggest_apply_step(),
+        )
+        if epoch is None:
+            # another decision is mid-handshake (or coordinatorship just
+            # moved): keep the samples, re-breach on a later tick
+            log.warning(
+                "feedback refit at step %d could not propose (control "
+                "slot busy); retrying on a later tick", step,
+            )
+            return None
+        self.refits += 1
+        self._detector.reset()
+        self.samples.clear()
+        record_event(
+            "feedback_refit", step=int(step), topo=payload["topo"],
+            invalidated=0, drift=drift, samples=payload["samples"],
+            control_epoch=epoch, proposed=True,
+        )
+        log.warning(
+            "feedback refit at step %d proposed as control epoch %d "
+            "(topo %s); group-wide apply on commit", step, epoch,
+            payload["topo"],
+        )
+        return None
+
+    def apply_committed(self, payload: dict, step: int | None = None):
+        """Apply a COMMITTED group replan on this rank: reconstruct the
+        constants, persist + invalidate, replan, and hand back the same
+        :class:`ReplanDecision` a local refit would have — ``fit`` swaps
+        the step through the identical path.  Deterministic from the
+        payload alone, so every rank lands on the same plan; a chooser
+        that disagrees with the broadcast spec (skewed local config)
+        follows the group and says so."""
+        new_params = _params_from_dict(dict(payload["params"]))
+        spec = payload.get("topo")
+        if self.cfg.calibration_path:
+            save_calibration(
+                self.cfg.calibration_path,
+                new_params,
+                backend=self._backend_name(),
+                fingerprint=self._fingerprint,
+                source="feedback",
+                meta={
+                    "samples": payload.get("samples"),
+                    "run_id": self.cfg.run_id or f"step{step}",
+                    "step": step,
+                    "fit": payload.get("fit_meta", {}),
+                    "drift": payload.get("drift", {}),
+                    "coordinated": True,
+                },
+            )
+        removed = invalidate_plan_cache(
+            cache_invalidation_predicate(self._fingerprint, None),
+            cache_path=self.cfg.plan_cache_path,
+        )
+        from ..runtime.coordination import apply_spec_override
+
+        plan = apply_spec_override(
+            choose_topology(self.n, self.nbytes, params=new_params),
+            spec,
+            self.n,
+        )
+        self.params = new_params
+        self._detector.reset()
+        self.samples.clear()
+        rebuilt = (
+            self.cfg.on_replan(plan, new_params)
+            if self.cfg.on_replan is not None
+            else None
+        )
+        return ReplanDecision(
+            plan,
+            new_params,
+            dict(payload.get("drift", {})),
+            removed,
+            dict(payload.get("fit_meta", {})),
+            rebuilt,
+        )
 
     # -- the default live-wire probe timer ------------------------------
 

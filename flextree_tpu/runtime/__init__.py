@@ -24,6 +24,13 @@ collectives on this pin, so the detection has to live at the host level:
   a :class:`TrainLeaseClient` is the handle ``fit(arbiter=...)`` polls to
   shrink/expand the training world when the arbiter moves chips between
   training and serving (``flextree_tpu.arbiter``, docs/ARBITER.md).
+- :mod:`.coordination` — the coordinated elastic control plane.  Every
+  elastic event (drift replan, shrink-to-survivors, lease resize)
+  becomes an epoch-numbered propose → ack → commit group decision on
+  the same directory (:class:`CoordinationHandle`), with coordinator
+  failover to the lowest-rank healthy member and epoch fencing for
+  ranks that miss the window; control files are torn-proof via
+  :mod:`.ctrlfile`'s length+CRC32 trailers (docs/COORDINATION.md).
 - :mod:`.preemption` — preemption-aware checkpointing.  A
   :class:`PreemptionGuard` turns SIGTERM into a "checkpoint now" fast
   path inside ``fit``; a :class:`BackgroundSaver` moves periodic saves
@@ -39,6 +46,16 @@ step timeouts, stragglers, preemption checkpoints) in the
 ``CHAOS_RUNTIME.json``); see docs/FAILURE_MODEL.md §Runtime failures.
 """
 
+from .coordination import (
+    ControlDecision,
+    CoordinationAbandoned,
+    CoordinationConfig,
+    CoordinationHandle,
+    CoordLedger,
+    EpochFenced,
+    ProtocolViolation,
+)
+from .ctrlfile import read_control_json, write_control_json
 from .leases import (
     ARBITER,
     SERVE,
@@ -82,4 +99,13 @@ __all__ = [
     "TRAIN",
     "SERVE",
     "ARBITER",
+    "ControlDecision",
+    "CoordLedger",
+    "CoordinationAbandoned",
+    "CoordinationConfig",
+    "CoordinationHandle",
+    "EpochFenced",
+    "ProtocolViolation",
+    "read_control_json",
+    "write_control_json",
 ]

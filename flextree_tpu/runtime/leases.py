@@ -36,13 +36,12 @@ same checkpoint → rebuild → restore machinery the shrink path proved.
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
-import tempfile
 import time
 from typing import Callable
 
 from ..utils.logging import get_logger
+from .ctrlfile import read_control_json, write_control_json
 
 __all__ = [
     "LEASE_FILE",
@@ -67,18 +66,6 @@ TRAIN, SERVE, ARBITER = "train", "serve", "arbiter"
 # injection point for tests (patch this, not time.time): lease files are
 # read across processes, so stamps are wall time like heartbeat beats
 _wall = time.time
-
-
-def _atomic_write_json(dir: str, path: str, payload: dict) -> None:
-    fd, tmp = tempfile.mkstemp(dir=dir, suffix=".lease.tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(payload, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
 
 
 @dataclasses.dataclass(frozen=True)
@@ -151,52 +138,86 @@ class LeaseLedger:
             wall=_wall(),
             reason=reason,
         )
-        _atomic_write_json(self.dir, self.path, grant.to_payload())
+        # CRC-trailered write (runtime.ctrlfile): a torn ledger must
+        # parse-refuse on every reader, never half-parse as a grant
+        write_control_json(self.dir, self.path, grant.to_payload())
         return grant
 
     # ---- reader side (every holder) ---------------------------------------
 
     def read(self) -> LeaseGrant | None:
         """The current ledger state (None before the first publish; a
-        torn/garbage file reads as None too — the replace discipline makes
-        that transient)."""
+        torn/garbage file parse-refuses to None too — the CRC trailer
+        makes truncation at any byte offset detectable, and the replace
+        discipline makes a mismatch transient)."""
+        doc = read_control_json(self.path)
+        if doc is None:
+            return None
         try:
-            with open(self.path, encoding="utf-8") as f:
-                doc = json.load(f)
             return LeaseGrant(
                 epoch=int(doc["epoch"]),
                 grants={h: tuple(c) for h, c in doc["grants"].items()},
                 wall=float(doc.get("wall", 0.0)),
                 reason=str(doc.get("reason", "")),
             )
-        except (OSError, ValueError, KeyError, TypeError):
+        except (ValueError, KeyError, TypeError, AttributeError):
             return None
 
-    def ack(self, holder: str, epoch: int) -> None:
-        """Record that ``holder`` now runs under ``epoch``'s grant."""
-        _atomic_write_json(
-            self.dir,
-            self._ack_path(holder),
-            {"holder": holder, "epoch": int(epoch), "wall": _wall()},
-        )
+    def ack(self, holder: str, epoch: int, control_epoch: int | None = None) -> None:
+        """Record that ``holder`` now runs under ``epoch``'s grant.
+
+        ``control_epoch`` (optional) names the coordination-protocol epoch
+        the holder applied the grant under (``runtime.coordination``) —
+        the fencing breadcrumb proving a multi-process tenant never acks
+        a lease it did not group-apply."""
+        payload = {"holder": holder, "epoch": int(epoch), "wall": _wall()}
+        if control_epoch is not None:
+            payload["control_epoch"] = int(control_epoch)
+        write_control_json(self.dir, self._ack_path(holder), payload)
+
+    def read_ack(self, holder: str) -> dict | None:
+        """``holder``'s newest ack document, or None (never acked / torn
+        — parse-refuses instead of raising on the arbiter thread).  One
+        read serves both the epoch and the control-epoch stamp, so a
+        caller never pairs fields from two different ack versions."""
+        return read_control_json(self._ack_path(holder))
 
     def acked_epoch(self, holder: str) -> int:
         """The newest epoch ``holder`` acknowledged (-1: never acked)."""
+        doc = self.read_ack(holder)
         try:
-            with open(self._ack_path(holder), encoding="utf-8") as f:
-                return int(json.load(f)["epoch"])
-        except (OSError, ValueError, KeyError, TypeError):
+            return int(doc["epoch"]) if doc is not None else -1
+        except (ValueError, KeyError, TypeError):
             return -1
+
+    def acked_control_epoch(self, holder: str) -> int | None:
+        """The coordination epoch stamped on ``holder``'s newest ack, when
+        the tenant runs under the coordination protocol (None otherwise)."""
+        doc = self.read_ack(holder)
+        if doc is None:
+            return None
+        ce = doc.get("control_epoch")
+        return int(ce) if ce is not None else None
 
 
 @dataclasses.dataclass(frozen=True)
 class ResizeDirective:
     """A grant change training has not applied yet: the new chip set and
-    the ledger epoch to acknowledge once the rebuild lands."""
+    the ledger epoch to acknowledge once the rebuild lands.
+
+    ``control_epoch`` names the coordination-protocol epoch that committed
+    this resize (``runtime.coordination``) — set only when the tenant is a
+    multi-process group, in which case the directive can ONLY come from a
+    committed group decision and the lease ack is fenced on it.  ``topo``
+    is the coordinator's replanned FT_TOPO spec for the new chip count,
+    broadcast so every rank applies THE SAME plan (the same override the
+    shrink commit carries)."""
 
     epoch: int
     chips: tuple
     reason: str = ""
+    control_epoch: int | None = None
+    topo: str | None = None
 
     @property
     def n(self) -> int:
@@ -218,6 +239,16 @@ class TrainLeaseClient:
     handshake — the arbiter cannot hand our revoked chips to serving
     until our ack exists, so a slow rebuild stretches the handoff instead
     of racing it.
+
+    ``coordination`` (optional): a
+    :class:`~flextree_tpu.runtime.CoordinationHandle` when this tenant is
+    a multi-process group.  A grant change then never becomes a directive
+    directly — the group's coordinator PROPOSES a ``"resize"`` decision
+    and every rank applies it through the committed control epoch
+    (``fit``'s coordination gate), so no rank can resize alone.  The
+    lease ack is fenced: :meth:`ack` refuses a directive that does not
+    carry the committed control epoch, which is exactly "a cross-process
+    tenant can never ack an epoch it didn't apply".
     """
 
     def __init__(
@@ -230,6 +261,7 @@ class TrainLeaseClient:
         configured: int | None = None,
         nbytes_hint: int = 4 << 20,
         poll_interval_s: float = 0.2,
+        coordination=None,
         _mono=time.monotonic,
     ):
         self.ledger = ledger
@@ -238,6 +270,8 @@ class TrainLeaseClient:
         self.configured = configured
         self.nbytes_hint = nbytes_hint
         self.poll_interval_s = float(poll_interval_s)
+        self.coordination = coordination
+        self._proposed_lease_epoch = -1
         self._mono = _mono
         self._next_poll = 0.0
         self._applied_epoch = -1
@@ -275,22 +309,79 @@ class TrainLeaseClient:
             return None
         if self.configured is not None:
             self.configured = max(self.configured, len(chips))
+        if self.coordination is not None:
+            # group tenant: the observation is not authority.  The
+            # coordinator turns it into a propose→ack→commit decision;
+            # every rank (this one included) receives the directive from
+            # the committed control epoch via fit's coordination gate.
+            # Followers return straight away — building the payload
+            # costs a planner solve, and only the coordinator's
+            # proposal can land.
+            if (
+                self._proposed_lease_epoch < grant.epoch
+                and self.coordination.is_coordinator
+            ):
+                payload = {
+                    "lease_epoch": grant.epoch,
+                    "chips": list(chips),
+                    "reason": grant.reason,
+                }
+                if chips:
+                    # broadcast the coordinator's replanned topology so
+                    # every rank applies THE SAME plan (a skewed local
+                    # calibration must not split the group — the same
+                    # override the shrink commit carries)
+                    from ..planner.choose import replan_for_survivors
+
+                    configured = max(self.configured or len(chips), len(chips))
+                    payload["topo"] = replan_for_survivors(
+                        len(chips), self.nbytes_hint, configured=configured
+                    ).to_ft_topo()
+                proposed = self.coordination.propose(
+                    "resize",
+                    payload,
+                    # one agreed boundary: on a shared-wire tenant a rank
+                    # rebuilding to the new chip plan while a peer still
+                    # steps the old one is a collective mismatch — the
+                    # same reason coordinated replans name a boundary
+                    apply_step=self.coordination.suggest_apply_step(),
+                )
+                if proposed is not None:
+                    self._proposed_lease_epoch = grant.epoch
+            return None
         return ResizeDirective(
             epoch=grant.epoch, chips=chips, reason=grant.reason
         )
 
-    def _adopt(self, epoch: int, chips: tuple) -> None:
+    def _adopt(
+        self, epoch: int, chips: tuple, control_epoch: int | None = None
+    ) -> None:
         self._applied_epoch = epoch
         self._chips = chips
         if self.configured is None or len(chips) > self.configured:
             self.configured = len(chips)
-        self.ledger.ack(self.holder, epoch)
+        self.ledger.ack(self.holder, epoch, control_epoch=control_epoch)
 
     def ack(self, directive: ResizeDirective) -> None:
         """The loop applied ``directive`` (checkpointed, rebuilt,
         restored): acknowledge the epoch so the arbiter may hand the
-        revoked chips on."""
-        self._adopt(directive.epoch, directive.chips)
+        revoked chips on.
+
+        Fenced under coordination: a group tenant's directive must carry
+        the control epoch that committed it — an ack for a lease epoch
+        this rank did not group-apply is refused loudly, never written."""
+        if self.coordination is not None and directive.control_epoch is None:
+            from .coordination import ProtocolViolation
+
+            raise ProtocolViolation(
+                f"lease epoch {directive.epoch} acked without a committed "
+                "control epoch — a coordinated tenant may only ack resizes "
+                "it applied through the group protocol"
+            )
+        self._adopt(
+            directive.epoch, directive.chips,
+            control_epoch=directive.control_epoch,
+        )
 
     @property
     def chips(self) -> tuple:

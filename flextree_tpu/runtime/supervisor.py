@@ -34,14 +34,13 @@ classifications feed ``RunReport.membership_epochs`` /
 
 from __future__ import annotations
 
-import json
 import os
-import tempfile
 import threading
 import time
 from dataclasses import dataclass
 
 from ..utils.logging import get_logger
+from .ctrlfile import read_control_json, write_control_json
 
 __all__ = [
     "HEALTHY",
@@ -168,15 +167,9 @@ class Supervisor:
             "heartbeat", hb_rank=self.cfg.rank, step=self._step,
             ewma_ms=self._ewma_ms, beats=self._beats,
         )
-        fd, tmp = tempfile.mkstemp(dir=self.cfg.dir, suffix=".beat.tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(payload, f)
-            os.replace(tmp, self.beat_path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        # CRC-trailered write (runtime.ctrlfile): a truncated or torn beat
+        # must parse-refuse on the reader, never half-parse as a fresh beat
+        write_control_json(self.cfg.dir, self.beat_path, payload)
         self._beats += 1
 
     def _loop(self) -> None:
@@ -246,6 +239,13 @@ class MembershipView:
         self.ewma_factor = ewma_factor
         self._seen: dict[int, dict] = {}
         self._last_states: dict[int, str] = {}  # lease-event edge detector
+        # monotonic-per-rank wall guard: the newest wall stamp ever read
+        # from each rank.  A beat whose wall moves BACKWARDS (NTP step,
+        # clock skew across hosts) must not resurrect a lease-expired rank
+        # or extend a live one — ages are computed against this watermark,
+        # and the regression is a loud `clock_regression` flight event.
+        self._max_wall: dict[int, float] = {}
+        self._regressed: set[int] = set()  # event edge: once per episode
         if configured:
             for r in range(configured):
                 self._seen.setdefault(r, {})
@@ -265,15 +265,42 @@ class MembershipView:
             names = os.listdir(self.dir)
         except OSError:
             return
+        from ..obs import record_event
+
         for name in names:
             if not (name.startswith("hb_") and name.endswith(".json")):
                 continue
-            try:
-                with open(os.path.join(self.dir, name)) as f:
-                    beat = json.load(f)
-                self._seen[int(beat["rank"])] = beat
-            except (OSError, ValueError, KeyError):
+            beat = read_control_json(os.path.join(self.dir, name))
+            if beat is None:
                 continue  # torn/removed mid-read: next poll sees the replace
+            try:
+                rank, wall = int(beat["rank"]), float(beat["wall"])
+            except (ValueError, KeyError, TypeError):
+                continue
+            watermark = self._max_wall.get(rank)
+            if watermark is not None and wall < watermark:
+                # clock regression: keep the watermark as the effective
+                # stamp (never extend a lease from a stepped-back clock;
+                # never resurrect an expired one), surface the episode
+                # loudly ONCE until the clock catches back up
+                if rank not in self._regressed:
+                    self._regressed.add(rank)
+                    record_event(
+                        "clock_regression", peer=rank,
+                        wall=wall, watermark=watermark,
+                        regression_s=round(watermark - wall, 3),
+                    )
+                    log.warning(
+                        "rank %d beat wall moved backwards by %.3fs "
+                        "(NTP step / cross-host skew); holding its lease "
+                        "age to the prior watermark",
+                        rank, watermark - wall,
+                    )
+                beat = dict(beat, wall=watermark)
+            else:
+                self._max_wall[rank] = wall
+                self._regressed.discard(rank)
+            self._seen[rank] = beat
 
     def poll(self) -> dict[int, PeerStatus]:
         """Classify every known rank; see the module docstring for the

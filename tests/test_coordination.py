@@ -1,0 +1,888 @@
+"""Tier-1 coverage for the coordinated elastic control plane (ISSUE 14):
+``runtime.ctrlfile`` torn-proof control files, the ``MembershipView``
+wall-clock-regression guard, and the ``runtime.coordination``
+propose→ack→commit state machine — driven pure-host with injectable
+clocks through randomized interleavings (coordinator death at each
+phase, duplicate acks, stale-epoch replays) against the protocol
+invariants: epochs strictly increase, at most one commit per epoch, no
+rank applies uncommitted state.  The same machinery runs against REAL
+processes and signals in ``tools/coord_chaos.py`` (committed
+``COORD_CHAOS.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from flextree_tpu.runtime import coordination as coordination_mod
+from flextree_tpu.runtime import supervisor as supervisor_mod
+from flextree_tpu.runtime.coordination import (
+    ControlDecision,
+    CoordinationConfig,
+    CoordinationHandle,
+    CoordLedger,
+    EpochFenced,
+    ProtocolViolation,
+    committed_shrink_plan,
+    decision_fingerprint,
+)
+from flextree_tpu.runtime.ctrlfile import (
+    read_control_json,
+    write_control_json,
+)
+from flextree_tpu.runtime.leases import (
+    LeaseLedger,
+    ResizeDirective,
+    TrainLeaseClient,
+)
+from flextree_tpu.runtime.supervisor import (
+    DEAD,
+    MembershipView,
+    Supervisor,
+    SupervisorConfig,
+)
+
+
+# ------------------------------------------------------------- ctrlfile
+
+
+class TestControlFiles:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "x.json")
+        write_control_json(str(tmp_path), path, {"a": 1, "b": [2, 3]})
+        assert read_control_json(path) == {"a": 1, "b": [2, 3]}
+
+    def test_absent_reads_none(self, tmp_path):
+        assert read_control_json(str(tmp_path / "nope.json")) is None
+
+    def test_truncation_at_every_byte_offset_refused(self, tmp_path):
+        """The satellite pin: a control file cut at ANY byte offset must
+        parse-refuse — including cuts that leave syntactically valid JSON
+        (the exact hole a trailer-less format cannot close)."""
+        path = str(tmp_path / "x.json")
+        write_control_json(
+            str(tmp_path), path, {"epoch": 12, "chips": [0, 1], "w": 1.5}
+        )
+        raw = (tmp_path / "x.json").read_bytes()
+        torn = str(tmp_path / "torn.json")
+        for cut in range(len(raw)):  # 0..len-1: every strict prefix
+            with open(torn, "wb") as f:
+                f.write(raw[:cut])
+            assert read_control_json(torn, rereads=0) is None, (
+                f"truncation at byte {cut}/{len(raw)} was accepted"
+            )
+
+    def test_corrupt_payload_byte_refused(self, tmp_path):
+        path = str(tmp_path / "x.json")
+        write_control_json(str(tmp_path), path, {"epoch": 3})
+        raw = bytearray((tmp_path / "x.json").read_bytes())
+        raw[2] ^= 0xFF  # flip one payload byte: CRC must catch it
+        with open(path, "wb") as f:
+            f.write(raw)
+        assert read_control_json(path, rereads=0) is None
+
+    def test_trailerless_plain_json_refused(self, tmp_path):
+        """A bare JSON file (hand-written, or a truncation that cut the
+        trailer off cleanly) is refused — accepting it would re-open the
+        clean-cut hole."""
+        path = tmp_path / "legacy.json"
+        path.write_text('{"epoch": 5}\n')
+        assert read_control_json(str(path), rereads=0) is None
+
+    def test_mismatch_rereads_then_reports_torn(self, tmp_path):
+        """A persistent mismatch re-reads (transient with atomic writers)
+        and then records a ``torn_control_file`` flight event instead of
+        raising on the polling thread."""
+        from flextree_tpu.obs import flight_recorder
+
+        path = tmp_path / "x.json"
+        path.write_text('{"epoch": 5}')  # no trailer: permanently torn
+        reads = {"n": 0}
+
+        def counting_sleep(_s):
+            reads["n"] += 1
+
+        with flight_recorder(str(tmp_path / "obs"), rank=0) as rec:
+            out = read_control_json(
+                str(path), rereads=2, _sleep=counting_sleep
+            )
+            assert out is None
+            # static content short-circuits the re-read loop: one sleep,
+            # then the identical second read proves nobody is mid-write
+            assert reads["n"] == 1
+            # and the torn report is EDGE-detected: a second read of the
+            # same stuck file must not record a second event
+            assert read_control_json(
+                str(path), rereads=2, _sleep=counting_sleep
+            ) is None
+            kinds = [e["kind"] for e in rec.events]
+        assert kinds.count("torn_control_file") == 1
+
+    def test_human_readable_first_line(self, tmp_path):
+        """`head -1 file` stays the debugging story."""
+        path = str(tmp_path / "x.json")
+        write_control_json(str(tmp_path), path, {"epoch": 7})
+        first = (tmp_path / "x.json").read_text().splitlines()[0]
+        assert json.loads(first) == {"epoch": 7}
+
+    def test_no_tmp_leftovers(self, tmp_path):
+        write_control_json(str(tmp_path), str(tmp_path / "x.json"), {"a": 1})
+        assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+# --------------------------------------------- clock-regression guard
+
+
+def _beat(dir, rank, wall, step=0):
+    write_control_json(
+        dir,
+        os.path.join(dir, f"hb_{rank:05d}.json"),
+        {"rank": rank, "pid": 1, "step": step, "ewma_ms": None,
+         "wall": wall, "beats": step},
+    )
+
+
+class TestClockRegression:
+    def test_backwards_wall_does_not_resurrect_expired_rank(
+        self, tmp_path, monkeypatch
+    ):
+        now = {"t": 1000.0}
+        monkeypatch.setattr(supervisor_mod, "_wall", lambda: now["t"])
+        d = str(tmp_path)
+        view = MembershipView(d, lease_s=3.0)
+        _beat(d, 1, wall=1000.0)
+        assert view.poll()[1].state != DEAD
+        now["t"] = 1010.0  # lease long expired
+        assert view.poll()[1].state == DEAD
+        # an NTP-stepped beat claims a FUTURE-then-past wall… here a beat
+        # stamped before the watermark must not resurrect the rank
+        _beat(d, 1, wall=999.0, step=5)
+        assert view.poll()[1].state == DEAD
+
+    def test_backwards_wall_does_not_extend_live_lease(
+        self, tmp_path, monkeypatch
+    ):
+        now = {"t": 1000.0}
+        monkeypatch.setattr(supervisor_mod, "_wall", lambda: now["t"])
+        d = str(tmp_path)
+        view = MembershipView(d, lease_s=3.0, straggler_s=1.0)
+        _beat(d, 1, wall=1000.0)
+        view.poll()
+        # the clock steps back 100 s but beats keep coming with the stale
+        # stamp: ages are computed against the 1000.0 watermark, so the
+        # rank expires on schedule instead of riding a 100 s extension
+        _beat(d, 1, wall=900.0, step=3)
+        now["t"] = 1004.0
+        assert view.poll()[1].state == DEAD
+
+    def test_regression_records_loud_event_once(self, tmp_path, monkeypatch):
+        from flextree_tpu.obs import flight_recorder
+
+        now = {"t": 1000.0}
+        monkeypatch.setattr(supervisor_mod, "_wall", lambda: now["t"])
+        d = str(tmp_path)
+        view = MembershipView(d, lease_s=30.0)
+        _beat(d, 1, wall=1000.0)
+        view.poll()
+        with flight_recorder(str(tmp_path / "obs"), rank=0) as rec:
+            _beat(d, 1, wall=990.0, step=1)
+            view.poll()
+            _beat(d, 1, wall=991.0, step=2)  # still behind: same episode
+            view.poll()
+            events = [e for e in rec.events if e["kind"] == "clock_regression"]
+        assert len(events) == 1
+        assert events[0]["peer"] == 1
+        assert events[0]["regression_s"] == pytest.approx(10.0)
+
+    def test_normal_forward_clock_never_fires_event(
+        self, tmp_path, monkeypatch
+    ):
+        from flextree_tpu.obs import flight_recorder
+
+        now = {"t": 1000.0}
+        monkeypatch.setattr(supervisor_mod, "_wall", lambda: now["t"])
+        d = str(tmp_path)
+        view = MembershipView(d)
+        with flight_recorder(str(tmp_path / "obs"), rank=0) as rec:
+            for i in range(5):
+                _beat(d, 1, wall=1000.0 + i, step=i)
+                now["t"] = 1000.0 + i
+                view.poll()
+            kinds = [e["kind"] for e in rec.events]
+        assert "clock_regression" not in kinds
+
+
+# ---------------------------------------------------------- the ledger
+
+
+class TestCoordLedger:
+    def test_epochs_strictly_increase(self, tmp_path):
+        led = CoordLedger(str(tmp_path))
+        d0 = ControlDecision(0, "replan", {"topo": "4"}, (0, 1), 0)
+        led.publish_proposal(d0, ack_deadline_wall=10.0)
+        with pytest.raises(ProtocolViolation, match="must increase"):
+            led.publish_proposal(
+                ControlDecision(0, "replan", {"topo": "2,2"}, (0, 1), 0),
+                ack_deadline_wall=10.0,
+            )
+        assert led.next_epoch() == 1
+
+    def test_commit_idempotent_same_content_only(self, tmp_path):
+        led = CoordLedger(str(tmp_path))
+        d0 = ControlDecision(3, "replan", {"topo": "4"}, (0, 1), 0)
+        assert led.publish_commit(d0) is True
+        assert led.publish_commit(d0) is False  # the failover race: no-op
+        with pytest.raises(ProtocolViolation, match="two decisions"):
+            led.publish_commit(
+                ControlDecision(3, "replan", {"topo": "2,2"}, (0, 1), 0)
+            )
+        with pytest.raises(ProtocolViolation, match="backwards"):
+            led.publish_commit(
+                ControlDecision(2, "replan", {"topo": "4"}, (0, 1), 0)
+            )
+
+    def test_torn_proposal_reads_absent(self, tmp_path):
+        led = CoordLedger(str(tmp_path))
+        (tmp_path / "coord_proposal.json").write_text('{"epoch": 9')
+        assert led.read_proposal() is None
+        assert led.next_epoch() == 0
+
+    def test_acks_scan(self, tmp_path):
+        led = CoordLedger(str(tmp_path))
+        led.ack(0, 4)
+        led.ack(2, 3)
+        (tmp_path / "coord_ack_00007.json").write_text("{garbage")
+        assert led.read_acks() == {0: 4, 2: 3}
+
+    def test_fingerprint_stable_and_content_sensitive(self):
+        a = decision_fingerprint("replan", {"topo": "4", "x": 1})
+        b = decision_fingerprint("replan", {"x": 1, "topo": "4"})
+        c = decision_fingerprint("replan", {"topo": "2,2", "x": 1})
+        assert a == b != c
+
+
+# ------------------------------------------------- handshake machine
+
+
+def _handles(dir, members, n=3, cfg=None, sleep=None):
+    return [
+        CoordinationHandle(
+            dir, r, membership=lambda: dict(members), cfg=cfg,
+            _sleep=sleep or (lambda s: None),
+        )
+        for r in range(n)
+    ]
+
+
+class TestHandshake:
+    def test_happy_path_apply_at_boundary(self, tmp_path):
+        members = {r: "healthy" for r in range(3)}
+        hs = _handles(str(tmp_path), members)
+        ep = hs[0].propose("replan", {"topo": "3"}, apply_step=5)
+        assert ep == 0
+        for h in hs[1:]:
+            assert h.gate(step=2) is None  # ack, no apply yet
+        assert hs[0].gate(step=2) is None  # all acks in -> commit
+        for h in hs:
+            assert h.gate(step=4) is None  # before the boundary: held
+            dec = h.gate(step=5)
+            assert dec is not None and dec.epoch == ep
+            h.mark_applied(dec)
+        assert [h.applied for h in hs] == [[0], [0], [0]]
+
+    def test_followers_never_propose(self, tmp_path):
+        members = {r: "healthy" for r in range(3)}
+        hs = _handles(str(tmp_path), members)
+        assert hs[1].propose("replan", {"topo": "3"}) is None
+        assert hs[0].ledger.read_proposal() is None
+
+    def test_one_decision_at_a_time(self, tmp_path):
+        members = {r: "healthy" for r in range(2)}
+        hs = _handles(str(tmp_path), members, n=2)
+        assert hs[0].propose("replan", {"topo": "2"}) == 0
+        assert hs[0].propose("replan", {"topo": "ring"}) is None  # slot busy
+
+    def test_duplicate_acks_harmless(self, tmp_path):
+        members = {r: "healthy" for r in range(2)}
+        hs = _handles(str(tmp_path), members, n=2)
+        ep = hs[0].propose("replan", {"topo": "2"})
+        for _ in range(4):
+            hs[1].gate(step=0)  # re-gating re-acks at most once per epoch
+        assert hs[1].ledger.read_acks()[1] == ep
+        assert hs[0].gate(step=0) is None  # commit
+        d0, d1 = hs[0].gate(step=1), hs[1].gate(step=1)
+        hs[0].mark_applied(d0)
+        hs[1].mark_applied(d1)
+        # replayed commit reads must not re-apply
+        assert hs[1].gate(step=2) is None
+
+    def test_double_apply_refused(self, tmp_path):
+        members = {0: "healthy"}
+        (h,) = _handles(str(tmp_path), members, n=1)
+        h.propose("replan", {"topo": "1"})
+        h.gate(step=0)
+        dec = h.gate(step=1)
+        h.mark_applied(dec)
+        with pytest.raises(ProtocolViolation, match="double-apply"):
+            h.mark_applied(dec)
+
+    def test_coordinator_death_before_any_ack_reproposes(
+        self, tmp_path, monkeypatch
+    ):
+        """Kill at phase=propose: rank 0 writes the proposal and dies
+        before anyone acks; past the deadline the successor excludes it
+        and re-proposes for the survivors."""
+        now = {"t": 100.0}
+        monkeypatch.setattr(coordination_mod, "_wall", lambda: now["t"])
+        members = {r: "healthy" for r in range(3)}
+        cfg = CoordinationConfig(ack_timeout_s=5.0)
+        hs = _handles(str(tmp_path), members, cfg=cfg)
+        ep = hs[0].propose("replan", {"topo": "3"})
+        # wipe rank 0's self-ack: it died before the ack landed
+        os.unlink(tmp_path / "coord_ack_00000.json")
+        members[0] = "dead"
+        assert hs[1].gate(step=0) is None  # acks; rank 0's ack missing
+        assert hs[2].gate(step=0) is None
+        now["t"] += 10.0  # past the ack deadline
+        assert hs[1].gate(step=0) is None  # successor re-proposes (epoch+1)
+        prop, _dl = hs[1].ledger.read_proposal()
+        assert prop.epoch == ep + 1
+        assert prop.coordinator == 1
+        assert 0 not in prop.participants
+        assert prop.fingerprint == decision_fingerprint(
+            "replan", {"topo": "3"}
+        )
+        assert hs[2].gate(step=0) is None  # ack the re-proposal
+        assert hs[1].gate(step=0) is None  # commit
+        d1, d2 = hs[1].gate(step=1), hs[2].gate(step=1)
+        assert d1.epoch == d2.epoch == ep + 1
+        assert d1.fingerprint == d2.fingerprint
+
+    def test_coordinator_death_after_acks_successor_completes(
+        self, tmp_path
+    ):
+        """Kill at phase=ack-collected: every ack (incl. the dead
+        coordinator's self-ack) is on disk; the successor COMPLETES the
+        in-flight commit at the SAME epoch instead of re-proposing."""
+        members = {r: "healthy" for r in range(3)}
+        hs = _handles(str(tmp_path), members)
+        ep = hs[0].propose("replan", {"topo": "ring"})
+        assert hs[1].gate(step=0) is None
+        assert hs[2].gate(step=0) is None
+        members[0] = "dead"  # dies with all acks in, commit unwritten
+        assert hs[1].gate(step=0) is None  # successor completes
+        commit = hs[1].ledger.read_commit()
+        assert commit is not None and commit.epoch == ep
+        d1, d2 = hs[1].gate(step=1), hs[2].gate(step=1)
+        hs[1].mark_applied(d1)
+        hs[2].mark_applied(d2)
+        assert hs[1].applied == hs[2].applied == [ep]
+
+    def test_coordinator_death_after_commit_is_just_applied(self, tmp_path):
+        """Kill at phase=commit: the commit is on disk; survivors apply it
+        with no successor action needed (and none taken twice)."""
+        members = {r: "healthy" for r in range(3)}
+        hs = _handles(str(tmp_path), members)
+        ep = hs[0].propose("replan", {"topo": "3"})
+        assert hs[1].gate(step=0) is None
+        assert hs[2].gate(step=0) is None
+        assert hs[0].gate(step=0) is None  # commit written
+        members[0] = "dead"
+        d1, d2 = hs[1].gate(step=1), hs[2].gate(step=1)
+        assert d1.epoch == d2.epoch == ep
+        hs[1].mark_applied(d1)
+        hs[2].mark_applied(d2)
+        # the commit slot stays sealed: nothing new in flight
+        assert hs[1].gate(step=2) is None
+
+    def test_recovered_coordinator_drives_foreign_proposal(self, tmp_path):
+        """A straggling rank 0 recovers to healthy while the successor's
+        proposal is mid-handshake: the CURRENT coordinator (rank 0 again)
+        must drive the foreign proposal to commit — deferring to the
+        live-but-demoted owner (who stopped driving the moment it lost
+        coordinatorship) would deadlock the slot forever."""
+        members = {0: "straggler", 1: "healthy", 2: "healthy"}
+        hs = _handles(str(tmp_path), members)
+        ep = hs[1].propose("replan", {"topo": "3"})  # rank 1 coordinates
+        assert ep == 0
+        members[0] = "healthy"  # rank 0 recovers mid-handshake
+        assert hs[2].gate(step=0) is None  # acks
+        assert hs[0].gate(step=0) is None  # acks + drives to commit
+        commit = hs[0].ledger.read_commit()
+        assert commit is not None and commit.epoch == ep
+        for h in hs:
+            dec = h.gate(step=1)
+            assert dec is not None and dec.epoch == ep
+            h.mark_applied(dec)
+
+    def test_stalled_follower_excluded_then_fenced(
+        self, tmp_path, monkeypatch
+    ):
+        """SIGSTOP signature: rank 2 misses the ack deadline, the decision
+        re-proposes for the ranks that acked, and the resumed rank finds
+        itself fenced by the epoch instead of training on a stale plan."""
+        now = {"t": 100.0}
+        monkeypatch.setattr(coordination_mod, "_wall", lambda: now["t"])
+        members = {r: "healthy" for r in range(3)}
+        cfg = CoordinationConfig(ack_timeout_s=5.0)
+        hs = _handles(str(tmp_path), members, cfg=cfg)
+        hs[0].propose("replan", {"topo": "3"})
+        assert hs[1].gate(step=0) is None  # acks; rank 2 is frozen
+        now["t"] += 6.0  # rank 2 silent past the deadline
+        members[2] = "straggler"  # stale beat, lease not expired
+        assert hs[0].gate(step=0) is None  # re-propose without rank 2
+        assert hs[1].gate(step=0) is None  # ack epoch 1
+        assert hs[0].gate(step=0) is None  # commit epoch 1
+        d0, d1 = hs[0].gate(step=1), hs[1].gate(step=1)
+        hs[0].mark_applied(d0)
+        hs[1].mark_applied(d1)
+        with pytest.raises(EpochFenced, match="excluded"):
+            hs[2].gate(step=1)  # resumed: the epoch moved past it
+
+    def test_fence_fires_guaranteed_dump(self, tmp_path):
+        from flextree_tpu.obs import flight_recorder
+
+        members = {0: "healthy", 1: "healthy"}
+        hs = _handles(str(tmp_path), members, n=2)
+        # a commit that excludes rank 1 entirely
+        hs[0].ledger.publish_commit(
+            ControlDecision(0, "shrink", {"alive": 1}, (0,), 0)
+        )
+        with flight_recorder(str(tmp_path / "obs"), rank=1) as rec:
+            with pytest.raises(EpochFenced):
+                hs[1].gate(step=0)
+            assert rec.dumps == 1
+        with open(rec.dump_path) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "coord_fence"
+
+    def test_abandoned_boundary_raises_typed(self, tmp_path, monkeypatch):
+        """A rank that acked a boundary whose decision never resolves
+        (every peer gone) raises CoordinationAbandoned, not a hang."""
+        now = {"t": 100.0}
+        monkeypatch.setattr(coordination_mod, "_wall", lambda: now["t"])
+        members = {0: "dead", 1: "healthy", 2: "dead"}
+
+        cfg = CoordinationConfig(resolve_timeout_s=10.0, ack_timeout_s=5.0)
+        h1 = CoordinationHandle(
+            str(tmp_path), 1, membership=lambda: dict(members), cfg=cfg,
+            _sleep=lambda s: now.__setitem__("t", now["t"] + 1.0),
+        )
+        led = CoordLedger(str(tmp_path))
+        # a proposal from rank 0 naming ONLY ranks 0 and 2 as still-needed
+        # ackers — rank 1 acks, then nobody is left to commit or re-propose
+        led.publish_proposal(
+            ControlDecision(
+                0, "replan", {"topo": "3"}, (0, 1, 2), 0, apply_step=4
+            ),
+            ack_deadline_wall=now["t"] + 5.0,
+        )
+        # rank 1 is the only healthy member => IS the coordinator and
+        # would normally resolve it itself; disable its driver to model
+        # the partition where no rank can resolve the decision
+        monkeypatch.setattr(type(h1), "_drive", lambda self, prop: None)
+        assert h1.gate(step=0) is None  # acks, boundary at 4
+        with pytest.raises(coordination_mod.CoordinationAbandoned):
+            h1.gate(step=4)
+
+    def test_stale_epoch_replay_rejected(self, tmp_path):
+        """A replayed (duplicate) proposal file at an old epoch cannot
+        regress the protocol: the ledger refuses the write."""
+        led = CoordLedger(str(tmp_path))
+        led.publish_commit(ControlDecision(5, "replan", {"t": 1}, (0,), 0))
+        with pytest.raises(ProtocolViolation):
+            led.publish_proposal(
+                ControlDecision(4, "replan", {"t": 0}, (0,), 0),
+                ack_deadline_wall=0.0,
+            )
+
+
+# ----------------------------------------- randomized interleavings
+
+
+class TestRandomizedInterleavings:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_invariants_under_random_schedules_and_kills(
+        self, tmp_path, monkeypatch, seed
+    ):
+        """Drive N handles in random order with a random coordinator kill
+        at a random point (possibly never) and assert the invariants on
+        quiescence: every surviving non-fenced rank applied the SAME
+        epoch sequence ending at the final commit, each epoch at most
+        once, and the commit fingerprint matches the proposal's."""
+        rng = random.Random(seed)
+        n = rng.choice([3, 4, 5])
+        now = {"t": 1000.0}
+        monkeypatch.setattr(coordination_mod, "_wall", lambda: now["t"])
+        members = {r: "healthy" for r in range(n)}
+        cfg = CoordinationConfig(ack_timeout_s=5.0)
+        hs = _handles(str(tmp_path / f"s{seed}"), members, n=n, cfg=cfg)
+        payload = {"topo": "3", "seed": seed}
+        hs[0].propose("replan", payload)
+        kill_at = rng.choice([None, 0, 1, 2, 3, 5, 8])
+        fenced: set[int] = set()
+        applied: dict[int, list] = {r: [] for r in range(n)}
+        for tick in range(60):
+            if tick == kill_at:
+                members[0] = "dead"
+                # a kill can land before the self-ack flushed: drop it
+                # half the time to model both interleavings
+                ackf = tmp_path / f"s{seed}" / "coord_ack_00000.json"
+                if rng.random() < 0.5 and ackf.exists():
+                    os.unlink(ackf)
+            order = [r for r in range(n) if members[r] == "healthy"]
+            rng.shuffle(order)
+            for r in order:
+                if r in fenced:
+                    continue
+                try:
+                    dec = hs[r].gate(step=tick)
+                except EpochFenced:
+                    fenced.add(r)
+                    continue
+                if dec is not None:
+                    hs[r].mark_applied(dec)
+                    applied[r].append((dec.epoch, dec.fingerprint))
+            now["t"] += 1.0
+        survivors = [
+            r for r in range(n)
+            if members[r] == "healthy" and r not in fenced
+        ]
+        assert survivors, "every rank died or was fenced"
+        commit = hs[survivors[0]].ledger.read_commit()
+        assert commit is not None, "the decision never committed"
+        assert commit.fingerprint == decision_fingerprint("replan", payload)
+        seqs = {tuple(applied[r]) for r in survivors}
+        assert len(seqs) == 1, f"divergent apply sequences: {seqs}"
+        (seq,) = seqs
+        assert seq, "survivors never applied the committed decision"
+        epochs = [e for e, _ in seq]
+        assert epochs == sorted(set(epochs)), "double-applied an epoch"
+        assert epochs[-1] == commit.epoch
+
+    def test_torn_control_files_mid_handshake(self, tmp_path):
+        """An adversarial scribbler truncating the proposal/commit between
+        every tick never wedges or corrupts the protocol — the CRC refuses
+        the torn read and the atomic replace restores the truth."""
+        rng = random.Random(42)
+        members = {r: "healthy" for r in range(3)}
+        d = str(tmp_path)
+        hs = _handles(d, members)
+        hs[0].propose("replan", {"topo": "3"})
+        applied = {r: [] for r in range(3)}
+        for tick in range(30):
+            for name in ("coord_proposal.json", "coord_commit.json"):
+                path = os.path.join(d, name)
+                if rng.random() < 0.4 and os.path.exists(path):
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                    cut = rng.randrange(1, len(raw))
+                    with open(path + ".torn", "wb") as f:
+                        f.write(raw[:cut])
+                    os.replace(path + ".torn", path)
+                    # the torn slot heals on the next publish below; also
+                    # model the writer re-publishing (atomic replace)
+                    with open(path, "wb") as f:
+                        f.write(raw)
+            for r in range(3):
+                dec = hs[r].gate(step=tick)
+                if dec is not None:
+                    hs[r].mark_applied(dec)
+                    applied[r].append(dec.epoch)
+        assert applied[0] == applied[1] == applied[2]
+        assert len(applied[0]) == 1
+
+
+# ------------------------------------------------- coordinated leases
+
+
+class TestCoordinatedLeases:
+    def _sole(self, dir):
+        """A single-member handle: always the coordinator."""
+        return CoordinationHandle(str(dir), 0, membership=None)
+
+    def test_grant_change_proposes_instead_of_directing(self, tmp_path):
+        ledger = LeaseLedger(str(tmp_path))
+        ledger.publish(0, {"train": (0, 1, 2, 3)})
+        handle = self._sole(tmp_path)
+        client = TrainLeaseClient(
+            ledger, initial_chips=(0, 1, 2, 3), coordination=handle,
+            poll_interval_s=0.0,
+        )
+        assert client.poll(0) is None  # adopts epoch 0
+        ledger.publish(1, {"train": (0, 1), "arbiter": (2, 3)})
+        assert client.poll(1) is None  # proposed, NOT directed
+        prop, _ = handle.ledger.read_proposal()
+        assert prop.kind == "resize"
+        assert prop.payload["lease_epoch"] == 1
+        assert prop.payload["chips"] == [0, 1]
+        # the commit delivers the directive through fit's gate; the
+        # client acks with the control epoch stamped
+        assert handle.gate(step=2) is None  # self-ack -> commit
+        dec = handle.gate(step=2)
+        assert dec is not None and dec.kind == "resize"
+        directive = ResizeDirective(
+            epoch=dec.payload["lease_epoch"],
+            chips=tuple(dec.payload["chips"]),
+            control_epoch=dec.epoch,
+        )
+        client.ack(directive)
+        handle.mark_applied(dec)
+        assert ledger.acked_epoch("train") == 1
+        assert ledger.acked_control_epoch("train") == dec.epoch
+
+    def test_ack_without_control_epoch_fenced(self, tmp_path):
+        ledger = LeaseLedger(str(tmp_path))
+        ledger.publish(0, {"train": (0, 1)})
+        client = TrainLeaseClient(
+            ledger, initial_chips=(0, 1), coordination=self._sole(tmp_path)
+        )
+        with pytest.raises(ProtocolViolation, match="control epoch"):
+            client.ack(ResizeDirective(epoch=1, chips=(0,)))
+
+    def test_uncoordinated_client_unchanged(self, tmp_path):
+        ledger = LeaseLedger(str(tmp_path))
+        ledger.publish(0, {"train": (0, 1)})
+        client = TrainLeaseClient(
+            ledger, initial_chips=(0, 1), poll_interval_s=0.0
+        )
+        ledger.publish(1, {"train": (0,), "arbiter": (1,)})
+        directive = client.poll(0)
+        assert directive is not None and directive.chips == (0,)
+        client.ack(directive)  # no control epoch required
+        assert ledger.acked_epoch("train") == 1
+        assert ledger.acked_control_epoch("train") is None
+
+
+# ---------------------------------------------- coordinated feedback
+
+
+class TestCoordinatedFeedback:
+    def _controller(self, tmp_path, handle, timer):
+        from flextree_tpu.planner.cost_model import TpuCostParams, LinkParams
+        from flextree_tpu.planner.feedback import (
+            FeedbackConfig,
+            FeedbackController,
+        )
+
+        # deliberately wrong constants so one probe round breaches the band
+        skewed = TpuCostParams(
+            ici=LinkParams(bandwidth_GBps=1e-3, latency_us=5000.0),
+            launch_us=5000.0,
+        )
+        return FeedbackController(
+            4,
+            1 << 20,
+            FeedbackConfig(
+                every_k=1, band=0.5, min_window=2, min_samples=4,
+                window=8,
+            ),
+            params=skewed,
+            coordination=handle,
+            timer=timer,
+        )
+
+    def test_follower_never_probes(self, tmp_path):
+        from flextree_tpu.obs import flight_recorder
+
+        members = {0: "healthy", 1: "healthy"}
+        follower = CoordinationHandle(
+            str(tmp_path), 1, membership=lambda: dict(members)
+        )
+
+        def exploding_timer(probes, n):  # pragma: no cover - must not run
+            raise AssertionError("follower probed")
+
+        ctl = self._controller(tmp_path, follower, exploding_timer)
+        with flight_recorder(str(tmp_path / "obs"), rank=1):
+            assert ctl.maybe_tick(10) is None
+        assert ctl.ticks == 0
+
+    def test_coordinator_drift_proposes_group_replan(self, tmp_path):
+        from flextree_tpu.obs import flight_recorder
+
+        handle = CoordinationHandle(str(tmp_path), 0, membership=None)
+        ctl = self._controller(
+            tmp_path, handle, lambda probes, n: [1e-4] * len(probes)
+        )
+        with flight_recorder(str(tmp_path / "obs"), rank=0):
+            out = None
+            for step in range(1, 6):
+                out = ctl.tick(step)
+                if handle.ledger.read_proposal() is not None:
+                    break
+            assert out is None  # propose-only: nothing applied locally
+            prop, _ = handle.ledger.read_proposal()
+            assert prop.kind == "replan"
+            assert "params" in prop.payload and "topo" in prop.payload
+            assert ctl.refits == 1
+
+            # the commit round-trips into the identical apply every rank runs
+            assert handle.gate(step=10) is None
+            dec = handle.gate(step=10)
+            assert dec is not None
+            applied = ctl.apply_committed(dec.payload, step=10)
+        assert applied.plan.to_ft_topo() == dec.payload["topo"]
+        assert applied.params.ici.bandwidth_GBps == pytest.approx(
+            dec.payload["params"]["ici_bandwidth_GBps"]
+        )
+
+    def test_apply_committed_follows_broadcast_spec(self, tmp_path):
+        """A rank whose local chooser disagrees with the broadcast winner
+        follows the group (the override path), never its own plan."""
+        from flextree_tpu.planner.calibrate import _params_to_dict
+        from flextree_tpu.planner.cost_model import TpuCostParams
+        from flextree_tpu.planner.feedback import (
+            FeedbackConfig,
+            FeedbackController,
+        )
+
+        ctl = FeedbackController(4, 1 << 20, FeedbackConfig())
+        payload = {
+            "params": _params_to_dict(TpuCostParams()),
+            "topo": "ring",  # almost surely not the local argmin for n=4
+        }
+        out = ctl.apply_committed(payload, step=3)
+        assert out.plan.to_ft_topo() == "1"  # the ring sentinel spec
+
+
+# ----------------------------------------------- fit-level wiring
+
+
+class _ToyData:
+    def batch_at(self, step):
+        tok = np.full((2, 4), float(step + 1))
+        return tok, tok
+
+
+def _toy_step():
+    def step_fn(state, tokens, targets):
+        s = int(np.asarray(state["step"]))
+        g = float(tokens.mean())
+        return (
+            {"step": np.int64(s + 1), "w": np.asarray(state["w"]) - 0.01 * g},
+            {"loss": g},
+        )
+
+    return step_fn
+
+
+def _w0():
+    return {"step": np.int64(0), "w": np.zeros(4, dtype=np.float64)}
+
+
+class TestFitCoordination:
+    def test_committed_shrink_applies_broadcast_plan(self, tmp_path):
+        """The fit seam: a confirmed death becomes a PROPOSAL, and the
+        shrink applies from the committed payload — survivor count and
+        topo from the broadcast, not recomputed ad hoc."""
+        from flextree_tpu.parallel.loop import (
+            FitConfig,
+            Supervision,
+            fit,
+        )
+
+        calls = {"n": 0}
+
+        def membership():
+            calls["n"] += 1
+            st = {r: "healthy" for r in range(4)}
+            if calls["n"] > 6:
+                st[3] = "dead"
+            return st
+
+        # a zero ack budget: the fictional peers (this is a one-process
+        # test; ranks 1-3 exist only in the membership view) are excluded
+        # on the first drive tick and the decision re-proposes for the
+        # ranks actually running the protocol — rank 0 alone
+        handle = CoordinationHandle(
+            str(tmp_path / "hb"), 0, membership=membership,
+            cfg=CoordinationConfig(ack_timeout_s=0.0),
+        )
+        rebuilt = []
+        res = fit(
+            _w0(), _toy_step(), _ToyData(),
+            FitConfig(
+                num_steps=10, ckpt_dir=str(tmp_path / "ck"), ckpt_every=2,
+                log_every=0, prefetch=0,
+            ),
+            supervision=Supervision(
+                membership=membership, configured_world=4,
+                on_shrink=lambda n, plan: rebuilt.append(
+                    (n, plan.to_ft_topo())
+                ),
+                nbytes_hint=1 << 20,
+                coordination=handle,
+            ),
+        )
+        assert res.steps_run == 10
+        epochs = res.report.membership_epochs
+        assert len(epochs) == 2 and epochs[1]["alive"] == 3
+        assert epochs[1]["dead"] == [3]
+        # the group decision trail: one applied control epoch, kind shrink
+        assert len(res.report.control_epochs) == 1
+        entry = res.report.control_epochs[0]
+        # epoch 1: epoch 0 named the fictional peers, which never acked
+        # and were excluded by the zero ack budget's re-proposal
+        assert entry["kind"] == "shrink" and entry["epoch"] == 1
+        commit = handle.ledger.read_commit()
+        assert commit is not None
+        assert commit.payload["alive"] == 3
+        assert commit.payload["topo"] == epochs[1]["topo"]
+        assert rebuilt == [(3, epochs[1]["topo"])]
+
+    def test_committed_shrink_plan_override(self):
+        payload = {"alive": 4, "configured": 8, "topo": "ring", "dead": [4]}
+        plan = committed_shrink_plan(payload, 1 << 20)
+        assert plan.to_ft_topo() == "1"  # the ring sentinel spec
+        payload2 = {"alive": 4, "configured": 8, "topo": "2,2", "dead": [4]}
+        assert committed_shrink_plan(payload2, 1 << 20).to_ft_topo() == "2,2"
+
+
+# ------------------------------------------------- timeline lane
+
+
+class TestTimelineLane:
+    def test_coord_kinds_render_on_dedicated_lane(self):
+        from flextree_tpu.obs.timeline import merge_events, validate_trace
+
+        events = [
+            {"ts": 1.0, "rank": 0, "seq": 0, "kind": "coord_propose",
+             "epoch": 0, "decision": "replan"},
+            {"ts": 1.1, "rank": 1, "seq": 0, "kind": "coord_ack", "epoch": 0},
+            {"ts": 1.2, "rank": 0, "seq": 1, "kind": "coord_commit",
+             "epoch": 0},
+            {"ts": 1.3, "rank": 1, "seq": 1, "kind": "coord_apply",
+             "epoch": 0},
+            {"ts": 1.4, "rank": 1, "seq": 2, "kind": "coord_failover",
+             "epoch": 1, "dead_coordinator": 0},
+            {"ts": 1.5, "rank": 2, "seq": 0, "kind": "coord_fence",
+             "epoch": 1},
+            {"ts": 1.6, "rank": 2, "seq": 1, "kind": "torn_control_file",
+             "path": "coord_commit.json"},
+            {"ts": 1.7, "rank": 0, "seq": 2, "kind": "clock_regression",
+             "peer": 2},
+        ]
+        doc = merge_events(events)
+        assert validate_trace(doc) == []
+        coord = [
+            ev for ev in doc["traceEvents"]
+            if ev.get("ph") == "i" and ev.get("tid") == 3
+        ]
+        assert {ev["name"] for ev in coord} == {
+            "coord_propose", "coord_ack", "coord_commit", "coord_apply",
+            "coord_failover", "coord_fence", "torn_control_file",
+            "clock_regression",
+        }
+        lanes = [
+            ev for ev in doc["traceEvents"]
+            if ev.get("ph") == "M" and ev.get("tid") == 3
+        ]
+        assert lanes and all(
+            ev["args"]["name"] == "coordination" for ev in lanes
+        )

@@ -207,16 +207,14 @@ def _spawn(role: str, env: dict):
 
 
 def _wait_for_step(hb_dir, rank, step, timeout=120.0) -> int:
+    from flextree_tpu.runtime import read_control_json
+
     path = os.path.join(hb_dir, f"hb_{rank:05d}.json")
     deadline = time.time() + timeout
     while time.time() < deadline:
-        try:
-            with open(path) as f:
-                beat = json.load(f)
-            if beat.get("step", -1) >= step:
-                return beat["step"]
-        except (OSError, ValueError):
-            pass
+        beat = read_control_json(path)  # beats are CRC-trailered now
+        if beat is not None and beat.get("step", -1) >= step:
+            return beat["step"]
         time.sleep(0.05)
     raise TimeoutError(f"rank {rank} never reached step {step}")
 
