@@ -865,6 +865,54 @@ def run_feedback_tripwire(timeout_s: int = 600) -> dict:
             pass
 
 
+def run_probe_free_tripwire(timeout_s: int = 600) -> dict:
+    """Supplementary keys ``probe_free_feedback_violations`` — per-step
+    cost attribution exercised end-to-end on this exact tree (ISSUE 15;
+    0 = a mis-calibrated start is detected and refit from host-timed
+    per-step spans alone, with ZERO dedicated probe collectives, the
+    refit carries per-phase scales, fleet pooling beats every
+    constituent run's conditioning, and the merged timeline renders
+    measured-vs-predicted span pairs) — and informational
+    ``probe_free_recovery_frac`` (its >= 0.9x-of-FEEDBACK.json floor is
+    enforced only in the committed full-run OBS_ATTRIBUTION.json).
+
+    Runs ``tools/probe_free_feedback.py --smoke`` in a subprocess; a
+    driver that fails to run reports ``probe_free_error`` with the keys
+    absent — absent reads as "not verified", never as "clean".
+    """
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "probe_free_feedback.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        out = {
+            "probe_free_feedback_violations": len(doc["violations"]),
+            "probe_free_recovery_frac": doc["timing"]["recovery_frac"],
+        }
+        if p.returncode != 0 and not doc["violations"]:
+            out["probe_free_error"] = (
+                f"probe_free_feedback rc={p.returncode}"
+            )
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"probe_free_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def run_arbiter_tripwire(timeout_s: int = 600) -> dict:
     """Supplementary keys ``arbiter_slo_violations`` — the elastic
     device pool exercised end-to-end on this exact tree (ISSUE 13; 0 = a
@@ -1035,6 +1083,7 @@ def main() -> int:
         result.update(run_paged_tripwire())
         result.update(run_obs_tripwire())
         result.update(run_feedback_tripwire())
+        result.update(run_probe_free_tripwire())
         result.update(run_arbiter_tripwire())
         result.update(run_coordination_tripwire())
     print(json.dumps(result))

@@ -33,6 +33,7 @@ from .metrics import (
     MetricsRegistry,
     WindowedHistogram,
     merged_window_percentile,
+    prometheus_exposition,
 )
 from .provenance import bucket_provenance, topo_spec
 from .recorder import (
@@ -44,12 +45,14 @@ from .recorder import (
     install_signal_dump,
     record_event,
 )
+from .stepclock import StepPlan, StepSample, StepSpanClock, plan_from_capture
 from .timeline import (
     ResidualSample,
     merge_dir,
     merge_events,
     read_dir,
     read_events,
+    residual_group_key,
     residual_pairs,
     residual_table,
     validate_trace,
@@ -64,7 +67,12 @@ __all__ = [
     "Histogram",
     "WindowedHistogram",
     "merged_window_percentile",
+    "prometheus_exposition",
     "MetricsRegistry",
+    "StepSpanClock",
+    "StepPlan",
+    "StepSample",
+    "plan_from_capture",
     "FlightRecorder",
     "flight_recorder",
     "current_recorder",
@@ -77,6 +85,7 @@ __all__ = [
     "read_dir",
     "read_events",
     "ResidualSample",
+    "residual_group_key",
     "residual_pairs",
     "residual_table",
     "validate_trace",

@@ -5,7 +5,10 @@ Usage::
     python -m flextree_tpu.obs merge  OBS_DIR --out timeline.json
     python -m flextree_tpu.obs validate timeline.json
     python -m flextree_tpu.obs summary OBS_DIR
-    python -m flextree_tpu.obs residuals OBS_DIR
+    python -m flextree_tpu.obs residuals OBS_DIR [--fingerprint FP] [--json]
+    python -m flextree_tpu.obs metrics OBS_DIR [--prom]
+    python -m flextree_tpu.obs fleet OBS_DIR [OBS_DIR ...] [--json]
+        [--fingerprint FP] [--fit-out CALIBRATION.json] [--backend B]
 
 ``merge`` fuses every ``flight_*.jsonl`` (+ ``*.dump.json``) under
 OBS_DIR into one timeline (ranks as tracks, requests/buckets as flows)
@@ -16,24 +19,69 @@ the 10-second "what did this run leave behind".  ``residuals`` prints
 the per-(topo, codec, tier) predicted-vs-measured comm residual table —
 the human-readable twin of ``planner.feedback``'s extractor, built from
 the SAME pairing code (``timeline.residual_pairs``) so the CLI and the
-fitter cannot diverge (docs/FEEDBACK.md).
+fitter cannot diverge (docs/FEEDBACK.md) — including the per-phase mix
+column and drift attribution the per-phase fit consumes;
+``--fingerprint`` narrows to one measuring backend and ``--json`` emits
+the machine-readable sample list instead of the table.  ``metrics``
+prints the per-rank ``metrics_{rank}.json`` registry snapshots; with
+``--prom`` they render as Prometheus text exposition (histogram
+``_bucket``/``_sum``/``_count`` series plus windowed ``_window_p99``
+gauges), so serving SLO instruments are scrapeable without parsing the
+JSON.  ``fleet`` is the cross-run pooling pass: it aggregates residual
+samples from MANY runs' obs dirs per backend fingerprint and fits the
+pooled set (``planner.feedback.fit_residuals_auto``) — one run's sample
+is deliberately small, the fleet's is not — reporting each constituent
+run's fit conditioning beside the pooled one; ``--fit-out`` persists the
+pooled refit as a calibration section (``source="feedback"`` with the
+fleet provenance in ``meta``).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
+import os
 import sys
 from collections import Counter as _Counter
 
+from .metrics import prometheus_exposition
 from .timeline import (
     merge_events,
     read_dir,
+    residual_group_key,
     residual_pairs,
     residual_table,
     validate_trace,
     write_trace,
 )
+
+
+def _sample_json(s) -> dict:
+    return {
+        "topo": s.topo,
+        "world": s.world,
+        "codec": s.codec,
+        "sharded": s.sharded,
+        "nbytes": s.nbytes,
+        "predicted_us": s.predicted_us,
+        "measured_us": s.measured_us,
+        "rel_residual": round(s.rel_residual, 6),
+        "fingerprint": s.fingerprint,
+        "step": s.step,
+        "source": s.source,
+        "phases": s.phases,
+    }
+
+
+def _dir_samples(dir: str):
+    events, _dumps = read_dir(dir)
+    return residual_pairs(events)
+
+
+def _fit_condition(meta: dict) -> float | None:
+    cond = meta.get("condition")
+    return float(cond) if isinstance(cond, (int, float)) else None
 
 
 def main(argv=None) -> int:
@@ -51,6 +99,40 @@ def main(argv=None) -> int:
         help="per-(topo, codec, tier) predicted-vs-measured residual table",
     )
     rp.add_argument("dir")
+    rp.add_argument(
+        "--fingerprint",
+        help="only samples measured under this backend fingerprint",
+    )
+    rp.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable sample list instead of the table",
+    )
+    xp = sub.add_parser(
+        "metrics", help="per-rank metrics registry snapshots"
+    )
+    xp.add_argument("dir")
+    xp.add_argument(
+        "--prom", action="store_true",
+        help="Prometheus text exposition instead of JSON",
+    )
+    fp = sub.add_parser(
+        "fleet",
+        help="pool residuals from many runs' obs dirs per fingerprint "
+        "and fit the pooled set",
+    )
+    fp.add_argument("dirs", nargs="+")
+    fp.add_argument("--fingerprint", help="fit only this fingerprint")
+    fp.add_argument("--json", action="store_true")
+    fp.add_argument(
+        "--fit-out",
+        help="persist the pooled refit as a calibration section "
+        "(source='feedback', fleet provenance in meta)",
+    )
+    fp.add_argument(
+        "--backend", default=None,
+        help="calibration section name for --fit-out (default: the "
+        "ambient jax backend)",
+    )
     args = ap.parse_args(argv)
 
     if args.cmd == "merge":
@@ -82,13 +164,171 @@ def main(argv=None) -> int:
         return 1 if bad else 0
 
     if args.cmd == "residuals":
-        events, _dumps = read_dir(args.dir)
-        if not events:
+        samples, skipped = _dir_samples(args.dir)
+        if not samples and not any(skipped.values()):
             print(f"no flight_*.jsonl events under {args.dir}", file=sys.stderr)
             return 1
-        samples, skipped = residual_pairs(events)
-        print(residual_table(samples, skipped))
+        if args.fingerprint:
+            samples = [s for s in samples if s.fingerprint == args.fingerprint]
+        if args.json:
+            print(json.dumps(
+                {
+                    "samples": [_sample_json(s) for s in samples],
+                    "skipped": skipped,
+                },
+                indent=1, sort_keys=True,
+            ))
+            return 0
+        # the per-group drift attribution the per-phase fit computes —
+        # lazy import keeps obs importable without the planner stack
+        attribution = None
+        if samples:
+            try:
+                from ..planner.feedback import attribute_groups
+
+                attribution = attribute_groups(samples)
+            except Exception:  # noqa: BLE001 — the table must still print
+                attribution = None
+        print(residual_table(samples, skipped, attribution=attribution))
         return 0
+
+    if args.cmd == "metrics":
+        snaps: dict[str, dict] = {}
+        for path in sorted(
+            _glob.glob(os.path.join(args.dir, "metrics_*.json"))
+        ):
+            stem = os.path.splitext(os.path.basename(path))[0]
+            rank = stem.split("_", 1)[1] if "_" in stem else stem
+            try:
+                with open(path, encoding="utf-8") as f:
+                    snaps[rank] = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"skipping {path}: {e}", file=sys.stderr)
+        if not snaps:
+            print(f"no metrics_*.json under {args.dir}", file=sys.stderr)
+            return 1
+        if args.prom:
+            sys.stdout.write(prometheus_exposition(snaps))
+        else:
+            print(json.dumps(snaps, indent=1, sort_keys=True))
+        return 0
+
+    if args.cmd == "fleet":
+        from ..planner.feedback import FeedbackRefused, fit_residuals_auto
+
+        runs = []
+        by_fp: dict = {}
+        fp_runs: dict = {}
+        for dir in args.dirs:
+            samples, skipped = _dir_samples(dir)
+            if args.fingerprint:
+                samples = [
+                    s for s in samples if s.fingerprint == args.fingerprint
+                ]
+            row = {
+                "dir": dir,
+                "samples": len(samples),
+                "skipped": skipped,
+                "condition": None,
+                "mode": None,
+                "refused": None,
+            }
+            if samples:
+                try:
+                    _params, meta = fit_residuals_auto(samples)
+                    row["condition"] = _fit_condition(meta)
+                    row["mode"] = meta.get("mode")
+                except FeedbackRefused as e:
+                    row["refused"] = str(e)[:200]
+            else:
+                row["refused"] = "no residual samples"
+            runs.append(row)
+            for s in samples:
+                by_fp.setdefault(s.fingerprint, []).append(s)
+                fp_runs.setdefault(s.fingerprint, set()).add(dir)
+
+        pooled: dict = {}
+        fitted_params: dict = {}
+        for fpr, samples in sorted(
+            by_fp.items(), key=lambda kv: str(kv[0])
+        ):
+            entry = {
+                "samples": len(samples),
+                "runs": len(fp_runs.get(fpr, ())),
+                "condition": None,
+                "mode": None,
+                "drifted_phase": None,
+                "refused": None,
+            }
+            try:
+                params, meta = fit_residuals_auto(samples)
+                entry["condition"] = _fit_condition(meta)
+                entry["mode"] = meta.get("mode")
+                entry["drifted_phase"] = meta.get("drifted_phase")
+                fitted_params[fpr] = (params, meta)
+            except FeedbackRefused as e:
+                entry["refused"] = str(e)[:200]
+            pooled[str(fpr)] = entry
+
+        out_doc = {"runs": runs, "pooled": pooled, "fit_out": None}
+        if args.fit_out and fitted_params:
+            from ..planner.calibrate import save_calibration
+
+            # persist the pooled fit with the most samples (or the one
+            # --fingerprint selected)
+            fpr = max(
+                fitted_params, key=lambda k: len(by_fp[k])
+            )
+            params, meta = fitted_params[fpr]
+            backend = args.backend
+            if backend is None:
+                try:
+                    import jax
+
+                    backend = jax.default_backend()
+                except Exception:  # noqa: BLE001
+                    backend = "cpu"
+            save_calibration(
+                args.fit_out, params, backend=backend,
+                fingerprint=fpr, source="feedback",
+                meta={
+                    "fleet": {
+                        "dirs": list(args.dirs),
+                        "samples": len(by_fp[fpr]),
+                        "fit": meta,
+                    }
+                },
+            )
+            out_doc["fit_out"] = args.fit_out
+        if args.json:
+            print(json.dumps(out_doc, indent=1, sort_keys=True))
+        else:
+            for r in runs:
+                status = (
+                    f"condition {r['condition']:.3g} ({r['mode']})"
+                    if r["condition"] is not None
+                    else f"refused: {r['refused']}"
+                )
+                print(f"{r['dir']}: {r['samples']} sample(s), {status}")
+            for fpr, e in pooled.items():
+                status = (
+                    f"condition {e['condition']:.3g} ({e['mode']}"
+                    + (f", drift {e['drifted_phase']}" if e["drifted_phase"]
+                       else "")
+                    + ")"
+                    if e["condition"] is not None
+                    else f"refused: {e['refused']}"
+                )
+                print(
+                    f"pooled[{fpr}]: {e['samples']} sample(s) from "
+                    f"{len(args.dirs)} dir(s), {status}"
+                )
+            if out_doc["fit_out"]:
+                print(f"wrote pooled calibration -> {out_doc['fit_out']}")
+        # pooling exists because single runs are thin: exit non-zero when
+        # NOTHING could be fitted — including when a --fingerprint filter
+        # (or empty dirs) left no samples to pool at all
+        return 0 if fitted_params else 1
 
     events, dumps = read_dir(args.dir)
     by_rank: dict[int, _Counter] = {}

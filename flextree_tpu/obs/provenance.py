@@ -87,9 +87,15 @@ def bucket_provenance(
     if dtype is not None:
         prov["dtype"] = str(dtype)
     try:
+        from ..planner.calibrate import default_params
         from ..planner.cost_model import allreduce_cost, lonely_allreduce_cost
         from ..schedule.stages import LonelyTopology
 
+        # the LIVE calibrated constants (FLEXTREE_CALIBRATION), not the
+        # invented dataclass defaults: the provenance contract is "the
+        # plan as priced" — the same params the planner chose the bucket
+        # size with, so per-step residuals judge the live model
+        params = default_params()
         total = 0.0
         breakdown: dict[str, float] = {}
         for ax in axes:
@@ -98,10 +104,10 @@ def bucket_provenance(
                 continue  # native psum: the model has no term for it
             if isinstance(topo, LonelyTopology):
                 cost = lonely_allreduce_cost(
-                    topo.tree, topo.lonely, int(nbytes), codec=codec
+                    topo.tree, topo.lonely, int(nbytes), params, codec=codec
                 )
             else:
-                cost = allreduce_cost(topo, int(nbytes), codec=codec)
+                cost = allreduce_cost(topo, int(nbytes), params, codec=codec)
             total += cost.total_us
             for key, val in dataclasses.asdict(cost).items():
                 breakdown[key] = round(breakdown.get(key, 0.0) + val, 3)

@@ -1,0 +1,341 @@
+"""Per-step cost attribution: host-timed steps keyed to compile-time plans.
+
+PR 10's provenance made every bucket span carry its predicted
+``CostBreakdown`` — but ``bucket_planned`` events fire at *trace* time
+(once per compile), and the only *measured* comm points were the feedback
+prober's dedicated collectives (PR 12).  This module closes the
+granularity gap named in docs/FEEDBACK.md / docs/OBSERVABILITY.md: every
+recorded training step becomes a measured sample against the plan that
+step ran, with zero extra collectives — the microbenchmark-style phase
+dissection of arXiv:1912.03413 obtained from production traffic instead
+of offline sweeps.
+
+Mechanics, and what is honestly measurable:
+
+- **the plan**: a freshly-compiled step's bucket plan is captured at
+  trace time (``utils.profiling.plan_capture`` hooks the same
+  ``comm_span`` calls that emit ``bucket_planned``), so the clock knows
+  exactly which (topo, world, codec, sharded, nbytes) points — and which
+  predicted per-phase :class:`~flextree_tpu.planner.cost_model.CostBreakdown`
+  terms — the step will run;
+- **the measurement**: the host times the whole materialized step
+  (``fit``'s step scope — the materialization boundary is the only
+  per-step instant a fused jitted program exposes to the host; the
+  per-bucket collectives inside it are NOT individually host-visible);
+- **attribution**: measured comm = step time minus the compute floor
+  (``compute_floor_us`` when the caller knows it, else a provisional
+  floor from the fastest observed step — see :meth:`StepSpanClock.floor_us`),
+  apportioned across the step's buckets by predicted share.  Apportioned
+  events are stamped ``apportioned: true``: within one step every
+  bucket's measured/predicted ratio is BY CONSTRUCTION the same, so
+  per-phase information comes from variation *across* plans (the
+  feedback controller's plan rotation, or fleet pooling across runs) —
+  never from one step alone.  The fitter respects this
+  (``planner.feedback``: apportioned samples feed the phase-scale solve
+  and the drift detector, not the point-wise α-β NNLS).
+
+Event contract (consumed by ``obs.timeline.residual_pairs``, the merger,
+and the ``obs fleet`` pooling pass):
+
+- ``step_measured``: one per sampled step — ``{step, step_us, floor_us,
+  comm_us, predicted_us, plan_sig, n_buckets}``;
+- ``bucket_measured`` with ``per_step: true``: one per bucket per sampled
+  step, carrying the same pairing keys ``bucket_planned`` uses (topo /
+  world / codec / sharded / nbytes) plus the predicted per-phase
+  breakdown, the apportioned ``measured_us``, and the ``plan_sig`` that
+  groups a step's buckets back together offline.
+
+Honest limits: the host-timed step must be MATERIALIZED (async dispatch
+times the enqueue, not the execution — ``fit`` materializes whenever the
+clock is armed); the provisional floor can only detect comm
+*over*-prediction (an under-predicted wire hides inside the floor —
+supply ``compute_floor_us``; the probe-free refit also needs it, to
+split its fitted intercept into floor + byte-phase time, after which the
+fit's implied floor replaces this one); and the first step after a
+(re)compile is excluded (it times tracing + compilation, not the plan).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from collections import deque
+
+from .recorder import record_event
+from .timeline import _PHASE_TERMS
+
+__all__ = [
+    "PlannedBucket",
+    "StepPlan",
+    "StepSample",
+    "StepSpanClock",
+    "plan_from_capture",
+    "PHASE_FIXED",
+    "PHASE_BYTES",
+    "PHASE_CODEC",
+]
+
+#: CostBreakdown terms grouped into the three independently-scalable
+#: phases the per-phase fit solves for: per-message fixed costs
+#: (launch+hop latency+control), byte-proportional costs (wire bandwidth
+#: + reduce — structurally collinear on an f32 wire, so they scale as
+#: one phase and re-split in the base calibration's ratio), and codec
+#: en/decode work (compressed wires only).  ONE definition, owned by
+#: ``obs.timeline._PHASE_TERMS`` — a term regrouped there regroups here.
+PHASE_FIXED = _PHASE_TERMS["fixed"]
+PHASE_BYTES = _PHASE_TERMS["bytes"]
+PHASE_CODEC = _PHASE_TERMS["codec"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannedBucket:
+    """One captured bucket-axis span: the pairing keys plus the predicted
+    per-phase breakdown, exactly as ``bucket_planned`` recorded them."""
+
+    name: str
+    axis: str
+    topo: str
+    world: int | None
+    nbytes: int
+    codec: str
+    sharded: bool
+    predicted: dict  # per-term CostBreakdown (µs), as recorded
+    predicted_us: float
+
+    @property
+    def fixed_us(self) -> float:
+        return sum(float(self.predicted.get(k, 0.0)) for k in PHASE_FIXED)
+
+    @property
+    def bytes_us(self) -> float:
+        return sum(float(self.predicted.get(k, 0.0)) for k in PHASE_BYTES)
+
+    @property
+    def codec_us(self) -> float:
+        return sum(float(self.predicted.get(k, 0.0)) for k in PHASE_CODEC)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepPlan:
+    """A compiled step's bucket plan with its per-phase predicted totals
+    — one row of the probe-free fit's design matrix."""
+
+    buckets: tuple
+    sig: str
+    fixed_us: float
+    bytes_us: float
+    codec_us: float
+    predicted_us: float
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSample:
+    """One measured step against the plan it ran."""
+
+    step: int
+    step_us: float
+    plan_sig: str
+    fixed_us: float  # the plan's predicted per-phase totals
+    bytes_us: float
+    codec_us: float
+    predicted_us: float
+
+
+def plan_from_capture(captured) -> StepPlan | None:
+    """Build a :class:`StepPlan` from ``plan_capture()`` output.  Spans
+    whose provenance carries no prediction (``predicted_error`` — the
+    cost model raised at trace time — or a bare span) are skipped, never
+    crashed on; ``None`` when nothing usable was captured."""
+    buckets = []
+    for name, prov in captured:
+        if not isinstance(prov, dict) or prov.get("predicted_error"):
+            continue
+        predicted = prov.get("predicted")
+        pred_us = prov.get("predicted_us")
+        nbytes = prov.get("nbytes")
+        if not isinstance(predicted, dict) or not isinstance(
+            pred_us, (int, float)
+        ) or nbytes is None:
+            continue
+        topo = prov.get("topo") or {}
+        world = prov.get("world") or {}
+        for ax in sorted(topo):
+            spec = str(topo[ax])
+            if spec == "psum":
+                continue  # no cost-model row: nothing to attribute
+            if spec == "1":
+                spec = "ring"
+            w = world.get(ax)
+            buckets.append(
+                PlannedBucket(
+                    name=str(name),
+                    axis=str(ax),
+                    topo=spec,
+                    world=int(w) if w is not None else None,
+                    nbytes=int(nbytes),
+                    codec=str(prov.get("codec", "f32")),
+                    sharded=bool(prov.get("sharded", False)),
+                    predicted=dict(predicted),
+                    predicted_us=float(pred_us),
+                )
+            )
+    if not buckets:
+        return None
+    buckets = tuple(buckets)
+    sig_src = [
+        (b.topo, b.world, b.codec, b.sharded, b.nbytes) for b in buckets
+    ]
+    sig = hashlib.sha256(
+        json.dumps(sig_src, sort_keys=True).encode("utf-8")
+    ).hexdigest()[:12]
+    return StepPlan(
+        buckets=buckets,
+        sig=sig,
+        fixed_us=sum(b.fixed_us for b in buckets),
+        bytes_us=sum(b.bytes_us for b in buckets),
+        codec_us=sum(b.codec_us for b in buckets),
+        predicted_us=sum(b.predicted_us for b in buckets),
+    )
+
+
+class StepSpanClock:
+    """The in-step span clock: hold the current compile's plan, fold each
+    materialized step's wall time into per-step measured spans.
+
+    ``compute_floor_us``: the step's non-comm floor when the caller knows
+    it (e.g. a timed sync-free twin — zero collectives, so supplying one
+    keeps a probe-free run probe-free).  ``None`` derives a provisional
+    floor: ``min(step_us − predicted_comm_us)`` over completed steps,
+    clamped at 0 — exact enough to *detect* over-predicted comm, refined
+    to a fitted intercept by the rotation fit (``planner.feedback``).
+    ``sample_every`` thins event emission (samples still accumulate every
+    step).  The caller gates on the flight recorder; the clock itself is
+    pure host bookkeeping.
+    """
+
+    def __init__(
+        self,
+        compute_floor_us: float | None = None,
+        sample_every: int = 1,
+        fingerprint: str | None = None,
+        max_samples: int = 512,
+    ):
+        self.compute_floor_us = (
+            float(compute_floor_us) if compute_floor_us is not None else None
+        )
+        self.sample_every = max(1, int(sample_every))
+        self.fingerprint = fingerprint
+        self.plan: StepPlan | None = None
+        self._plan_steps = 0  # steps observed under the current plan
+        self._floor_min: float | None = None  # provisional-floor tracker
+        # bounded to the recent regime: a healthy run must not grow the
+        # buffer forever (the same invariant the controller's residual
+        # deque keeps), and a refit should solve from recent windows —
+        # 512 steps comfortably covers a full rotation cycle set
+        self.samples: deque[StepSample] = deque(
+            maxlen=max(int(max_samples), 8)
+        )
+        self.dropped_first = 0  # compile steps excluded per plan
+
+    # -- plan management -----------------------------------------------
+
+    def set_plan(self, captured) -> StepPlan | None:
+        """Adopt a freshly-captured compile-time plan (the step that
+        produced the capture is the COMPILING call — its duration will be
+        excluded).  Returns the adopted plan, or None when the capture
+        held nothing usable (the previous plan is kept)."""
+        plan = plan_from_capture(captured)
+        if plan is None:
+            return None
+        self.plan = plan
+        self._plan_steps = 0
+        return plan
+
+    @property
+    def floor_us(self) -> float | None:
+        """The best available compute floor: the configured one, else the
+        provisional minimum of (step − predicted comm) seen so far."""
+        if self.compute_floor_us is not None:
+            return self.compute_floor_us
+        return self._floor_min
+
+    # -- the per-step hook ---------------------------------------------
+
+    def observe_step(self, step: int, dur_s: float) -> StepSample | None:
+        """Fold one materialized step's wall time.  Returns the
+        :class:`StepSample` (also appended to ``samples``), or None when
+        no plan is known or this is the plan's first (compiling) step."""
+        plan = self.plan
+        if plan is None:
+            return None
+        self._plan_steps += 1
+        if self._plan_steps == 1:
+            # the compiling call: its wall time is tracing+compilation
+            self.dropped_first += 1
+            return None
+        step_us = float(dur_s) * 1e6
+        if self.compute_floor_us is None:
+            slack = max(step_us - plan.predicted_us, 0.0)
+            if self._floor_min is None or slack < self._floor_min:
+                self._floor_min = slack
+        sample = StepSample(
+            step=int(step),
+            step_us=step_us,
+            plan_sig=plan.sig,
+            fixed_us=plan.fixed_us,
+            bytes_us=plan.bytes_us,
+            codec_us=plan.codec_us,
+            predicted_us=plan.predicted_us,
+        )
+        self.samples.append(sample)
+        if (self._plan_steps - 2) % self.sample_every == 0:
+            self._emit(sample, plan)
+        return sample
+
+    def comm_us(self, sample: StepSample) -> float | None:
+        """The sample's measured comm estimate under the current floor
+        (None while no floor exists)."""
+        floor = self.floor_us
+        if floor is None:
+            return None
+        return max(sample.step_us - floor, 1e-3)
+
+    # -- event emission -------------------------------------------------
+
+    def _emit(self, sample: StepSample, plan: StepPlan) -> None:
+        floor = self.floor_us
+        comm = self.comm_us(sample)
+        record_event(
+            "step_measured",
+            step=sample.step,
+            step_us=round(sample.step_us, 3),
+            floor_us=round(floor, 3) if floor is not None else None,
+            comm_us=round(comm, 3) if comm is not None else None,
+            predicted_us=round(plan.predicted_us, 3),
+            plan_sig=plan.sig,
+            n_buckets=len(plan.buckets),
+        )
+        if comm is None or plan.predicted_us <= 0:
+            return
+        for b in plan.buckets:
+            share = b.predicted_us / plan.predicted_us
+            record_event(
+                "bucket_measured",
+                name=b.name,
+                axis=b.axis,
+                topo={b.axis: b.topo},
+                world={b.axis: b.world},
+                nbytes=b.nbytes,
+                codec=b.codec,
+                sharded=b.sharded,
+                measured_us=round(comm * share, 3),
+                predicted_us=round(b.predicted_us, 3),
+                predicted=b.predicted,
+                fingerprint=self.fingerprint,
+                step=sample.step,
+                per_step=True,
+                apportioned=True,
+                plan_sig=plan.sig,
+                floor_us=round(floor, 3),
+            )

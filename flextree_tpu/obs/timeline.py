@@ -57,6 +57,8 @@ __all__ = [
     "ResidualSample",
     "residual_pairs",
     "residual_table",
+    "residual_group_key",
+    "phase_components",
 ]
 
 #: kinds rendered on the heartbeat lane (tid 1) instead of the main lane
@@ -88,11 +90,19 @@ _START_SUFFIX, _END_SUFFIX = "_start", "_end"
 #: comm-plan kinds rendered as predicted-duration spans
 _PLAN_KINDS = frozenset({"bucket_planned", "bucket_fired", "collective"})
 
-#: measured-comm kinds (the feedback prober's timed collective runs,
-#: planner/feedback.py) rendered as spans whose duration is the MEASURED
+#: measured-comm kinds rendered as spans whose duration is the MEASURED
 #: time — the twin of the comm-plan spans above, so Perfetto shows the
-#: prediction and the measurement side by side
-_MEASURED_KINDS = frozenset({"bucket_measured"})
+#: prediction and the measurement side by side.  ``bucket_measured``
+#: comes from the feedback prober's timed collectives (planner/
+#: feedback.py) AND from the per-step span clock (obs/stepclock.py:
+#: ``per_step: true``, host-timed steps apportioned over the compile-time
+#: plan); ``serve_round_measured`` is the serving engine's decode round
+#: against the paged-decode cost estimate (serving/costs.py).
+_MEASURED_KINDS = frozenset({"bucket_measured", "serve_round_measured"})
+
+#: whole-step measured spans (obs/stepclock.py): duration is the step's
+#: host wall time, args carry the comm/floor split and the plan signature
+_STEP_MEASURED_KINDS = frozenset({"step_measured"})
 
 _META_KEYS = frozenset({"ts", "rank", "src", "seq", "kind"})
 
@@ -235,7 +245,26 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
             trace.append(
                 {
                     "name": str(args.get("name", kind)),
-                    "cat": "comm-measured",
+                    "cat": (
+                        "serve-measured"
+                        if kind == "serve_round_measured"
+                        else "comm-measured"
+                    ),
+                    "ph": "X",
+                    **common,
+                    "dur": round(dur, 1),
+                    "args": args,
+                }
+            )
+            continue
+
+        if kind in _STEP_MEASURED_KINDS:
+            args = _args(ev)
+            dur = max(float(args.get("step_us") or 1.0), 1.0)
+            trace.append(
+                {
+                    "name": f"step_measured {args.get('step', '')}".strip(),
+                    "cat": "step-measured",
                     "ph": "X",
                     **common,
                     "dur": round(dur, 1),
@@ -423,6 +452,28 @@ def validate_trace(doc) -> list[str]:
 # ---------------------------------------------------------------------------
 
 
+#: CostBreakdown terms grouped into the three independently-identifiable
+#: phases (shared with obs/stepclock.py and the planner.feedback phase
+#: fit): per-message fixed costs, byte-proportional costs (wire +
+#: reduce, structurally collinear on an f32 wire), and codec work.
+_PHASE_TERMS = {
+    "fixed": ("latency_us", "control_us"),
+    "bytes": ("bandwidth_us", "reduce_us"),
+    "codec": ("codec_us",),
+}
+
+
+def phase_components(breakdown: dict | None) -> dict | None:
+    """Collapse a per-term ``CostBreakdown`` dict into the three fit
+    phases ``{"fixed", "bytes", "codec"}`` (µs).  None in, None out."""
+    if not isinstance(breakdown, dict):
+        return None
+    return {
+        phase: sum(float(breakdown.get(t, 0.0)) for t in terms)
+        for phase, terms in _PHASE_TERMS.items()
+    }
+
+
 @dataclasses.dataclass(frozen=True)
 class ResidualSample:
     """One predicted-vs-measured comm point read off a flight record."""
@@ -439,8 +490,17 @@ class ResidualSample:
     ts: float | None = None
     #: "paired" when the prediction came from a matching ``bucket_planned``
     #: span; "self" when the measured event carried its own prediction
-    #: (the prober prices with the same model the planner used)
+    #: (the prober prices with the same model the planner used); "step"
+    #: for per-step span-clock samples (obs/stepclock.py) — host-timed
+    #: step totals apportioned over the compile-time plan, so within one
+    #: step their measured/predicted ratios are uniform by construction
+    #: (they feed the phase-scale fit and the drift detector, never the
+    #: point-wise α-β solve)
     source: str = "paired"
+    #: the predicted per-term CostBreakdown behind ``predicted_us`` when
+    #: the record carried one — the component-wise residual material the
+    #: per-phase fit consumes (planner.feedback.fit_phase_scales)
+    predicted_breakdown: dict | None = None
 
     @property
     def rel_residual(self) -> float:
@@ -448,6 +508,12 @@ class ResidualSample:
         return abs(self.predicted_us - self.measured_us) / max(
             self.measured_us, 1e-9
         )
+
+    @property
+    def phases(self) -> dict | None:
+        """Predicted µs per fit phase (fixed / bytes / codec), or None
+        when the record carried no breakdown."""
+        return phase_components(self.predicted_breakdown)
 
 
 def _plan_points(ev: dict):
@@ -500,7 +566,7 @@ def residual_pairs(events) -> tuple[list[ResidualSample], dict]:
         "invalid_measured": 0,
         "unmeasured_plans": 0,
     }
-    predicted: dict[tuple, float] = {}
+    predicted: dict[tuple, tuple] = {}  # key -> (pred_us, breakdown|None)
     matched: set = set()
     for ev in events:
         if ev.get("kind") != "bucket_planned":
@@ -511,9 +577,11 @@ def residual_pairs(events) -> tuple[list[ResidualSample], dict]:
         pred = ev.get("predicted_us")
         if not isinstance(pred, (int, float)):
             continue  # a bare span with no costed prediction: nothing to pair
+        breakdown = ev.get("predicted")
+        breakdown = dict(breakdown) if isinstance(breakdown, dict) else None
         for key in _pairing_keys(ev):
             # latest prediction wins: a recompile re-prices the same point
-            predicted[key] = float(pred)
+            predicted[key] = (float(pred), breakdown)
 
     samples: list[ResidualSample] = []
     for ev in events:
@@ -527,13 +595,22 @@ def residual_pairs(events) -> tuple[list[ResidualSample], dict]:
         if not keys:
             skipped["unpredicted"] += 1
             continue
+        own_breakdown = ev.get("predicted")
+        own_breakdown = (
+            dict(own_breakdown) if isinstance(own_breakdown, dict) else None
+        )
+        per_step = bool(ev.get("per_step"))
         for key in keys:
             spec, world, codec, sharded, nbytes = key
             if key in predicted:
-                pred, source = predicted[key], "paired"
+                (pred, breakdown), source = predicted[key], "paired"
                 matched.add(key)
+                # the measured event's own breakdown is the fresher view
+                # (the prober/span clock prices with the live constants)
+                breakdown = own_breakdown or breakdown
             elif isinstance(ev.get("predicted_us"), (int, float)):
                 pred, source = float(ev["predicted_us"]), "self"
+                breakdown = own_breakdown
             else:
                 skipped["unpredicted"] += 1
                 continue
@@ -549,18 +626,57 @@ def residual_pairs(events) -> tuple[list[ResidualSample], dict]:
                     fingerprint=ev.get("fingerprint"),
                     step=ev.get("step"),
                     ts=ev.get("ts"),
-                    source=source,
+                    source="step" if per_step else source,
+                    predicted_breakdown=breakdown,
                 )
             )
     skipped["unmeasured_plans"] = len(set(predicted) - matched)
     return samples, skipped
 
 
-def residual_table(samples, skipped: dict | None = None) -> str:
+def residual_group_key(s: ResidualSample) -> tuple:
+    """The CLI/fit grouping of a residual sample: (topo, codec, tier)
+    where ``tier`` is the group size plus the sharded flag (the per-tier
+    grouping the two-tier roadmap item will refine)."""
+    tier = f"n{s.world if s.world is not None else '?'}" + (
+        "/sharded" if s.sharded else ""
+    )
+    return (s.topo, s.codec, tier)
+
+
+def _phase_mix(grp) -> str:
+    """Median predicted per-phase mix of a sample group, as
+    ``fixed/bytes/codec`` percentage string (``-`` when no sample in the
+    group carried a breakdown)."""
+    mixes = []
+    for s in grp:
+        ph = s.phases
+        if ph is None:
+            continue
+        total = sum(ph.values())
+        if total <= 0:
+            continue
+        mixes.append([ph["fixed"] / total, ph["bytes"] / total,
+                      ph["codec"] / total])
+    if not mixes:
+        return "-"
+    med = [
+        statistics.median(m[i] for m in mixes) for i in range(3)
+    ]
+    return "/".join(f"{round(100 * v):d}" for v in med) + "%"
+
+
+def residual_table(
+    samples, skipped: dict | None = None, attribution: dict | None = None
+) -> str:
     """Human-readable per-(topo, codec, tier) residual summary — the CLI
     twin of the feedback fitter's extractor (``python -m flextree_tpu.obs
-    residuals DIR``).  ``tier`` is the group size plus the sharded flag
-    (the per-tier grouping the two-tier roadmap item will refine)."""
+    residuals DIR``).  The ``phases f/b/c`` column is the group's median
+    predicted phase mix (fixed/bytes/codec — the component-wise
+    ``CostBreakdown`` shares the per-phase fit consumes); ``attribution``
+    optionally maps :func:`residual_group_key` keys to a drifted-phase
+    string (``planner.feedback.attribute_groups``) rendered as a final
+    ``drift`` column."""
     if not samples:
         lines = ["no predicted-vs-measured residual pairs in this record"]
         if skipped and skipped.get("unmeasured_plans"):
@@ -573,23 +689,28 @@ def residual_table(samples, skipped: dict | None = None) -> str:
 
     groups: dict[tuple, list[ResidualSample]] = {}
     for s in samples:
-        tier = f"n{s.world if s.world is not None else '?'}" + (
-            "/sharded" if s.sharded else ""
-        )
-        groups.setdefault((s.topo, s.codec, tier), []).append(s)
+        groups.setdefault(residual_group_key(s), []).append(s)
     head = (
         f"{'topo':>10} {'codec':>6} {'tier':>10} {'count':>6} "
-        f"{'med pred':>10} {'med meas':>10} {'med |r|':>8} {'max |r|':>8}"
+        f"{'med pred':>10} {'med meas':>10} {'med |r|':>8} {'max |r|':>8} "
+        f"{'phases f/b/c':>13}"
     )
+    if attribution:
+        head += f" {'drift':>14}"
     lines = [head, "-" * len(head)]
-    for (topo, codec, tier), grp in sorted(groups.items()):
-        lines.append(
+    for key, grp in sorted(groups.items()):
+        topo, codec, tier = key
+        row = (
             f"{topo:>10} {codec:>6} {tier:>10} {len(grp):>6} "
             f"{statistics.median(s.predicted_us for s in grp):>9.1f}u "
             f"{statistics.median(s.measured_us for s in grp):>9.1f}u "
             f"{statistics.median(s.rel_residual for s in grp):>8.3f} "
-            f"{max(s.rel_residual for s in grp):>8.3f}"
+            f"{max(s.rel_residual for s in grp):>8.3f} "
+            f"{_phase_mix(grp):>13}"
         )
+        if attribution:
+            row += f" {attribution.get(key, '-'):>14}"
+        lines.append(row)
     if skipped:
         parts = [f"{k}={v}" for k, v in sorted(skipped.items()) if v]
         if parts:

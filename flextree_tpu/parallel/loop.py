@@ -42,6 +42,7 @@ postmortem needs it.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import math
@@ -59,7 +60,7 @@ from ..utils.checkpoint import (
     save_train_state,
 )
 from ..utils.logging import get_logger
-from ..utils.profiling import step_scope
+from ..utils.profiling import plan_capture, step_scope
 
 __all__ = [
     "FitConfig",
@@ -856,15 +857,40 @@ def fit(
             if sup is None:
                 new_state, metrics = cur_step_fn(state, tokens, targets)
             else:
+                # probe-free feedback (docs/FEEDBACK.md): when the
+                # controller wants per-step spans (probe_free=True with
+                # the recorder on — recorder off costs one None check),
+                # capture the compile-time bucket plan while a fresh step
+                # traces, MATERIALIZE the step (async dispatch would time
+                # the enqueue, not the execution), and feed the host-timed
+                # duration to the span clock below.
+                fb = sup.feedback
+                fb_spans = (
+                    fb is not None
+                    and not feedback_dead
+                    and hasattr(fb, "wants_step_spans")
+                    and fb.wants_step_spans()
+                )
+                fb_cap = None
+                t_step0 = time.perf_counter()
                 try:
-                    with step_scope(on_duration=_feed_supervisor):
+                    with contextlib.ExitStack() as _stack:
+                        _stack.enter_context(
+                            step_scope(on_duration=_feed_supervisor)
+                        )
+                        if fb_spans:
+                            fb_cap = _stack.enter_context(plan_capture())
                         new_state, metrics = (
                             watchdog.run(
                                 _materialized_step, state, tokens, targets,
                                 timeout_s=step_timeout, step=step,
                             )
                             if watchdog is not None
-                            else cur_step_fn(state, tokens, targets)
+                            else (
+                                _materialized_step(state, tokens, targets)
+                                if fb_spans
+                                else cur_step_fn(state, tokens, targets)
+                            )
                         )
                 except StepTimeout as e:
                     report.step_timeouts += 1
@@ -887,6 +913,25 @@ def fit(
                         continue
                     raise
                 timeout_retries = 0
+                if fb_spans:
+                    try:
+                        if fb_cap:
+                            fb.set_step_plan(fb_cap)
+                        fb.observe_step(
+                            step, time.perf_counter() - t_step0
+                        )
+                    except Exception as e:  # noqa: BLE001 — obs contract
+                        # span bookkeeping must never kill the run: same
+                        # disarm semantics as a raising tick below
+                        feedback_dead = True
+                        record_event(
+                            "feedback_error", step=step,
+                            reason=f"{type(e).__name__}: {e}"[:300],
+                        )
+                        log.exception(
+                            "per-step span clock failed at step %d; "
+                            "planner feedback disarmed for the run", step,
+                        )
             record_event("step_end", step=step)
             if cfg.nan_guard and not _metrics_finite(metrics):
                 report.anomalies += 1
@@ -940,7 +985,18 @@ def fit(
                 # step rebuild) on a plan no step will ever run.
                 try:
                     decision = sup.feedback.maybe_tick(step)
-                    if decision is not None:
+                    if decision is not None and getattr(
+                        decision, "rotation", False
+                    ):
+                        # a probe-free plan-rotation swap: a bucket-size
+                        # variant of the same plan (bitwise-invariant),
+                        # applied through the replan swap path but NOT a
+                        # refit — the controller recorded feedback_rotate
+                        if decision.rebuilt is not None:
+                            (cur_step_fn, cur_mesh, cur_specs,
+                             cur_pack, cur_unpack) = _apply_rebuild(
+                                 decision.rebuilt, cur_pack, cur_unpack)
+                    elif decision is not None:
                         report.feedback_refits += 1
                         if decision.rebuilt is not None:
                             # the same swap the shrink path runs, minus the

@@ -280,16 +280,26 @@ class CoordLedger:
 
     # ---- acks -------------------------------------------------------------
 
-    def ack(self, rank: int, epoch: int) -> None:
+    def ack(self, rank: int, epoch: int, extra: dict | None = None) -> None:
+        """Write ``rank``'s ack for ``epoch``.  ``extra`` rides along in
+        the same file — the follower-drift channel: a rank's local
+        drift-window summary (``planner.feedback.DriftDetector.summary``)
+        ships under ``extra["drift"]`` so the coordinator's next propose
+        decision sees pooled cross-rank skew, not just its own wire."""
         write_control_json(
             self.dir,
             self._ack_path(rank),
-            {"rank": int(rank), "epoch": int(epoch), "wall": _wall()},
+            {
+                **(extra or {}),
+                "rank": int(rank),
+                "epoch": int(epoch),
+                "wall": _wall(),
+            },
         )
 
-    def read_acks(self) -> dict[int, int]:
-        """{rank: newest acked epoch} over every ack file in the dir."""
-        out: dict[int, int] = {}
+    def read_ack_docs(self) -> dict[int, dict]:
+        """{rank: full ack payload} over every ack file in the dir."""
+        out: dict[int, dict] = {}
         try:
             names = os.listdir(self.dir)
         except OSError:
@@ -301,7 +311,17 @@ class CoordLedger:
             if doc is None:
                 continue
             try:
-                out[int(doc["rank"])] = int(doc["epoch"])
+                out[int(doc["rank"])] = doc
+            except (ValueError, KeyError, TypeError):
+                continue
+        return out
+
+    def read_acks(self) -> dict[int, int]:
+        """{rank: newest acked epoch} over every ack file in the dir."""
+        out: dict[int, int] = {}
+        for rank, doc in self.read_ack_docs().items():
+            try:
+                out[rank] = int(doc["epoch"])
             except (ValueError, KeyError, TypeError):
                 continue
         return out
@@ -410,6 +430,11 @@ class CoordinationHandle:
         self.cfg = cfg or CoordinationConfig()
         self.on_fence = on_fence
         self._sleep = _sleep
+        # follower-drift channel: when set (FeedbackController wires its
+        # detector's summary here), every ack this rank writes carries the
+        # current drift-window summary under "drift" — the coordinator
+        # reads the pooled view via peer_drift() before proposing
+        self.drift_provider: Callable[[], dict] | None = None
         self._applied_epoch = -1
         self._acked_epoch = -1
         # follower-side boundary promise: (epoch, apply_step) of the
@@ -630,8 +655,45 @@ class CoordinationHandle:
 
         record_event(kind, coord_rank=self.rank, **fields)
 
+    def peer_drift(self, min_epoch: int | None = None) -> dict[int, dict]:
+        """{rank: drift-window summary} from every OTHER rank's newest
+        ack — the pooled cross-rank skew view the feedback controller's
+        propose decision consumes.  Summaries are only as fresh as each
+        rank's last ack (a group with no prior decision has none yet —
+        the first proposal is decided from the coordinator's own view).
+
+        ``min_epoch`` drops summaries attached to acks for OLDER epochs:
+        an ack is written when a rank *observes* a proposal — before the
+        apply resets its detector — so after a replan commits at epoch E,
+        every surviving ack's drift describes the PRE-refit world.
+        Pooling those would immediately re-trigger the drift that was
+        just corrected; the controller passes ``applied_epoch + 1`` so
+        only summaries written since the last applied decision count."""
+        out: dict[int, dict] = {}
+        for rank, doc in self.ledger.read_ack_docs().items():
+            if rank == self.rank:
+                continue
+            if min_epoch is not None:
+                try:
+                    if int(doc.get("epoch", -1)) < min_epoch:
+                        continue
+                except (TypeError, ValueError):
+                    continue
+            drift = doc.get("drift")
+            if isinstance(drift, dict) and drift:
+                out[rank] = drift
+        return out
+
     def _ack(self, decision: ControlDecision) -> None:
-        self.ledger.ack(self.rank, decision.epoch)
+        extra = None
+        if self.drift_provider is not None:
+            try:
+                summary = self.drift_provider()
+            except Exception:  # noqa: BLE001 — telemetry never blocks an ack
+                summary = None
+            if summary:
+                extra = {"drift": summary}
+        self.ledger.ack(self.rank, decision.epoch, extra=extra)
         self._acked_epoch = decision.epoch
         self._pending = (decision.epoch, decision.apply_step)
         self._pending_wall = _wall()

@@ -1,0 +1,108 @@
+"""Paged-decode cost estimates: the serving planner's predicted side.
+
+The training stack prices every collective before it runs and PR 12
+closed the loop on the residuals; serving had measured histograms
+(round/TTFT) but no predictions to hold them against.  This module
+supplies the predicted half so the engine can emit
+``serve_round_measured`` spans — measured decode round (and prefill)
+time beside a cost estimate priced from the SAME calibratable constants
+the rest of the planner uses (``TpuCostParams.bwd_GFLOPs`` as the
+achievable compute throughput, ``reduce_bw_GBps`` as the HBM-bound
+byte-stream rate).
+
+The estimate is deliberately first-order: dense projection FLOPs per
+decoded token plus the attention walk's K/V byte traffic over the batch
+causal frontier (the paged pools are read once per round up to the
+frontier — exactly the quantity the fused kernel's win shrinks with,
+BENCH_PAGED.json).  It does not model dispatch overlap or sampling-host
+time; that is what the residual loop is FOR — drift between this
+estimate and the measured rounds is the serving-side feedback signal,
+per-phase attributable like the training residuals (compute-bound vs
+byte-bound terms are separate fields of the prediction).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "decode_round_flops",
+    "decode_round_bytes",
+    "predict_decode_round_us",
+    "predict_prefill_us",
+]
+
+
+def _dense_flops_per_token(cfg) -> float:
+    """Dense (projection + MLP + LM head) multiply-accumulate FLOPs to
+    decode one token: 2·weights touched."""
+    d, ff = cfg.d_model, cfg.d_ff
+    per_layer = 4 * d * d + 2 * d * ff  # qkvo + in/out MLP
+    return 2.0 * (cfg.n_layers * per_layer + d * cfg.vocab_size)
+
+
+def decode_round_flops(cfg, n_active: int, max_len: int) -> float:
+    """FLOPs for one decode round over ``n_active`` slots attending up to
+    ``max_len`` positions (the batched walk runs to the batch frontier)."""
+    attn = 4.0 * max_len * cfg.d_model * cfg.n_layers  # QK^T + AV per token
+    return n_active * (_dense_flops_per_token(cfg) + attn)
+
+
+def decode_round_bytes(cfg, pcfg, n_active: int, frontier_blocks: int) -> float:
+    """K/V pool bytes streamed in one decode round: every active slot
+    reads the pools up to the batch frontier (blocks × block_size
+    positions × K and V × heads × head_dim × itemsize × layers)."""
+    try:
+        import numpy as np
+
+        itemsize = np.dtype(cfg.dtype).itemsize
+    except TypeError:
+        itemsize = 4
+    per_pos = 2 * cfg.n_heads * cfg.head_dim * itemsize * cfg.n_layers
+    return float(n_active * frontier_blocks * pcfg.block_size * per_pos)
+
+
+def predict_decode_round_us(
+    cfg, pcfg, n_active: int, max_len: int, params=None
+) -> dict:
+    """Predicted decode-round time, split into the two attributable
+    phases: ``compute_us`` (dense+attention FLOPs over the calibrated
+    achievable throughput) and ``bytes_us`` (K/V streaming at the
+    HBM-bound byte rate).  Returns ``{"predicted_us", "compute_us",
+    "bytes_us"}`` — the per-term decomposition the serving residual
+    stream attributes drift against."""
+    from ..parallel.overlap import resolve_bwd_GFLOPs
+    from ..planner.calibrate import default_params
+
+    if params is None:
+        params = default_params()
+    if n_active <= 0:
+        return {"predicted_us": 0.0, "compute_us": 0.0, "bytes_us": 0.0}
+    frontier_blocks = min(
+        (max(int(max_len), 1) + pcfg.block_size - 1) // pcfg.block_size,
+        pcfg.blocks_per_seq,
+    )
+    gflops = max(resolve_bwd_GFLOPs(params), 1e-6)
+    compute_us = decode_round_flops(cfg, n_active, max_len) / (gflops * 1e3)
+    bytes_us = decode_round_bytes(cfg, pcfg, n_active, frontier_blocks) / (
+        max(params.reduce_bw_GBps, 1e-6) * 1e3
+    )
+    return {
+        "predicted_us": compute_us + bytes_us,
+        "compute_us": compute_us,
+        "bytes_us": bytes_us,
+    }
+
+
+def predict_prefill_us(cfg, prompt_len: int, params=None) -> float:
+    """Predicted prefill compute time for one prompt (the TTFT floor a
+    non-queued request could hit): dense FLOPs for every prompt token
+    plus the causal attention triangle."""
+    from ..parallel.overlap import resolve_bwd_GFLOPs
+    from ..planner.calibrate import default_params
+
+    if params is None:
+        params = default_params()
+    t = max(int(prompt_len), 1)
+    dense = _dense_flops_per_token(cfg) * t
+    attn = 2.0 * t * t * cfg.d_model * cfg.n_layers
+    gflops = max(resolve_bwd_GFLOPs(params), 1e-6)
+    return (dense + attn) / (gflops * 1e3)

@@ -67,6 +67,11 @@ from .kv_cache import (
 # use, observed once per scheduling round (engine.report() embeds it)
 _OCCUPANCY_BUCKETS = tuple(round(0.1 * i, 1) for i in range(1, 11))
 
+# relative-residual buckets for the serving feedback loop: |predicted -
+# measured| / measured of each decode round vs the paged-decode cost
+# estimate (serving/costs.py) — ratio-scaled, not ms-scaled
+_RESIDUAL_BUCKETS = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0)
+
 __all__ = ["CompletedRequest", "ServingEngine"]
 
 # injection point for tests (patch this, not time.monotonic) — one clock
@@ -202,10 +207,12 @@ class ServingEngine:
         active = self.batcher.active_slots()
         if active:
             tables, lengths, tokens, _ = self.batcher.batch_arrays()
+            t_dec = _now()
             logits, self.pools = self._decode(
                 self.params, self.pools, tables, lengths, tokens
             )
             logits = np.asarray(logits)  # host fetch = the step boundary
+            decode_s = _now() - t_dec
             now = _now()
             for slot in active:
                 tok = self._pick(slot, logits[slot])
@@ -213,6 +220,9 @@ class ServingEngine:
             self.decode_steps += 1
             self.metrics.counter("serve.decode_tokens").inc(len(active))
             record_event("serve_decode", n_active=len(active))
+            self._round_feedback(
+                len(active), int(np.asarray(lengths).max()), decode_s
+            )
         finished = self.batcher.retire_ready()
         for slot, state in finished:
             self._keys.pop(slot, None)
@@ -344,7 +354,48 @@ class ServingEngine:
             length=state.length, blocks=n,
         )
 
+    def _round_feedback(
+        self, n_active: int, max_len: int, measured_s: float
+    ) -> None:
+        """The serving-side feedback sample: one decode round's measured
+        time against the paged-decode cost estimate (serving/costs.py),
+        observed into the ``serve.round_residual`` histogram (the drift
+        signal ``engine.report()`` exposes) and emitted as a
+        ``serve_round_measured`` span — the serving twin of the training
+        stack's ``bucket_measured`` events, rendered beside its
+        prediction in the merged timeline."""
+        from .costs import predict_decode_round_us
+
+        pred = predict_decode_round_us(
+            self.cfg, self.pcfg, n_active, max_len, self._cost_params()
+        )
+        measured_us = float(measured_s) * 1e6
+        predicted_us = pred["predicted_us"]
+        rel = abs(predicted_us - measured_us) / max(measured_us, 1e-9)
+        self.metrics.histogram(
+            "serve.round_residual", buckets=_RESIDUAL_BUCKETS
+        ).observe(rel)
+        record_event(
+            "serve_round_measured",
+            round=self.decode_steps,
+            n_active=int(n_active),
+            max_len=int(max_len),
+            measured_us=round(measured_us, 3),
+            predicted_us=round(predicted_us, 3),
+            compute_us=round(pred["compute_us"], 3),
+            bytes_us=round(pred["bytes_us"], 3),
+        )
+
+    def _cost_params(self):
+        params = getattr(self, "_cost_params_cache", None)
+        if params is None:
+            from ..planner.calibrate import default_params
+
+            params = self._cost_params_cache = default_params()
+        return params
+
     def _prefill_slot(self, slot: int, state: SeqState) -> None:
+        t0 = _now()
         req = state.request
         prompt = np.asarray(req.prompt, np.int32)[None]
         logits, cache = self._prefill(self.params, prompt)
@@ -367,8 +418,19 @@ class ServingEngine:
         self.metrics.histogram("serve.ttft_ms").observe(
             (now - req.arrival_s) * 1e3
         )
-        record_event("serve_prefill", rid=req.rid, slot=slot,
-                     prompt_len=req.prompt_len)
+        from .costs import predict_prefill_us
+
+        record_event(
+            "serve_prefill", rid=req.rid, slot=slot,
+            prompt_len=req.prompt_len,
+            measured_us=round((now - t0) * 1e6, 3),
+            predicted_us=round(
+                predict_prefill_us(
+                    self.cfg, req.prompt_len, self._cost_params()
+                ),
+                3,
+            ),
+        )
 
     def _pick(self, slot: int, logits_row: np.ndarray) -> int:
         state = self.batcher.slots[slot]

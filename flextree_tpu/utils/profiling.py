@@ -36,6 +36,7 @@ __all__ = [
     "span_bytes",
     "SpanLedger",
     "span_ledger",
+    "plan_capture",
     "exposed_split",
     "Ewma",
     "step_scope",
@@ -143,6 +144,13 @@ def span_bytes(name: str) -> int | None:
 
 _ACTIVE_LEDGERS: list[SpanLedger] = []
 
+#: active plan captures: every ``comm_span`` entered with a provenance
+#: payload appends ``(name, provenance)`` to each — the trace-time hook
+#: the per-step span clock (``obs/stepclock.py``) uses to learn WHICH
+#: buckets a freshly-compiled step will run, so per-step measured spans
+#: can be keyed to the compile-time provenance without re-deriving it
+_ACTIVE_PLAN_CAPTURES: list[list] = []
+
 
 @contextlib.contextmanager
 def span_ledger():
@@ -155,6 +163,24 @@ def span_ledger():
         yield ledger
     finally:
         _ACTIVE_LEDGERS.remove(ledger)
+
+
+@contextlib.contextmanager
+def plan_capture():
+    """Collect every provenance-carrying ``comm_span`` entered in this
+    block as ``(name, provenance_dict)`` pairs — the compile-time bucket
+    plan of whatever traced under it.  Like :func:`span_ledger` this is
+    trace-time bookkeeping: under ``jit`` the spans fire while tracing,
+    so wrapping a step's FIRST (compiling) call yields its full bucket
+    plan and wrapping an already-compiled call yields nothing.  The list
+    is shared module state (not thread-local) deliberately: the watchdog
+    runs steps on a worker thread and the capture must still see them."""
+    cap: list = []
+    _ACTIVE_PLAN_CAPTURES.append(cap)
+    try:
+        yield cap
+    finally:
+        _ACTIVE_PLAN_CAPTURES.remove(cap)
 
 
 def exposed_split(step_ms: float, nosync_step_ms: float, comm_total_ms: float):
@@ -207,6 +233,8 @@ def comm_span(
     from ..obs import record_event
 
     if provenance is not None:
+        for cap in _ACTIVE_PLAN_CAPTURES:
+            cap.append((name, provenance))
         record_event("bucket_planned", name=name, **provenance)
     else:
         record_event("collective", name=name, bytes=span_bytes(name))

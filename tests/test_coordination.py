@@ -886,3 +886,76 @@ class TestTimelineLane:
         assert lanes and all(
             ev["args"]["name"] == "coordination" for ev in lanes
         )
+
+
+# ---------------------------------------- follower drift in acks (ISSUE 15)
+
+
+class TestFollowerDrift:
+    def test_ack_ships_drift_provider_summary(self, tmp_path):
+        members = {r: "healthy" for r in range(3)}
+        hs = _handles(str(tmp_path), members)
+        summary = {"fp|8|tree|f32|False": {"median": 1.7, "count": 6}}
+        hs[1].drift_provider = lambda: summary
+        hs[0].propose("replan", {"topo": "3"}, apply_step=9)
+        assert hs[1].gate(step=1) is None  # follower acks
+        docs = hs[0].ledger.read_ack_docs()
+        assert docs[1]["drift"] == summary
+        assert docs[1]["epoch"] == 0
+        # rank 0 set no provider: its ack carries no drift field
+        assert "drift" not in docs[0]
+
+    def test_peer_drift_excludes_self_and_reads_others(self, tmp_path):
+        members = {r: "healthy" for r in range(3)}
+        hs = _handles(str(tmp_path), members)
+        mine = {"k": {"median": 9.0, "count": 4}}
+        theirs = {"k": {"median": 2.0, "count": 8}}
+        hs[0].drift_provider = lambda: mine
+        hs[2].drift_provider = lambda: theirs
+        hs[0].propose("replan", {"topo": "3"})
+        for h in hs[1:]:
+            h.gate(step=1)
+        peer = hs[0].peer_drift()
+        assert 0 not in peer  # own windows come from the local detector
+        assert peer[2] == theirs
+        assert 1 not in peer  # rank 1 shipped no summary
+
+    def test_raising_drift_provider_never_blocks_the_ack(self, tmp_path):
+        members = {r: "healthy" for r in range(2)}
+        hs = _handles(str(tmp_path), members, n=2)
+        hs[1].drift_provider = lambda: (_ for _ in ()).throw(
+            RuntimeError("detector broken")
+        )
+        hs[0].propose("replan", {"topo": "2"})
+        assert hs[1].gate(step=1) is None  # ack still lands
+        assert hs[0].ledger.read_acks()[1] == 0
+
+    def test_controller_registers_detector_summary(self, tmp_path):
+        from flextree_tpu.planner.feedback import (
+            FeedbackConfig,
+            FeedbackController,
+        )
+
+        members = {r: "healthy" for r in range(2)}
+        hs = _handles(str(tmp_path), members, n=2)
+        ctl = FeedbackController(
+            8, 1 << 20, FeedbackConfig(), coordination=hs[1],
+            timer=lambda p, n: [0.001] * len(p),
+        )
+        assert hs[1].drift_provider is not None
+        assert hs[1].drift_provider() == ctl._detector.summary()
+
+    def test_peer_drift_min_epoch_drops_pre_refit_summaries(self, tmp_path):
+        # an ack is written PRE-apply, so after a replan applies, its
+        # epoch's summaries describe the corrected world's past — the
+        # controller passes applied_epoch + 1 to drop them
+        members = {r: "healthy" for r in range(2)}
+        hs = _handles(str(tmp_path), members, n=2)
+        hs[1].drift_provider = lambda: {"k": {"median": 3.0, "count": 8}}
+        hs[0].propose("replan", {"topo": "2"})
+        hs[1].gate(step=1)
+        assert hs[0].peer_drift(min_epoch=0) == {
+            1: {"k": {"median": 3.0, "count": 8}}
+        }
+        # as if epoch 0 was applied: its ack's summary no longer pools
+        assert hs[0].peer_drift(min_epoch=1) == {}

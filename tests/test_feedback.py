@@ -1038,3 +1038,402 @@ class TestHelpers:
         empty = tmp_path / "empty"
         empty.mkdir()
         assert main(["residuals", str(empty)]) == 1
+
+
+# ----------------------------------------------- per-phase + probe-free
+
+
+def _breakdown(spec, nbytes, params, n=8):
+    import dataclasses
+
+    return {
+        k: round(v, 6)
+        for k, v in dataclasses.asdict(
+            fb.predict_spec_cost(spec, n, nbytes, params)
+        ).items()
+    }
+
+
+def _fixed_phase_ratio(spec="8", nb=1 << 17):
+    bt, bs = _breakdown(spec, nb, TRUE), _breakdown(spec, nb, SKEW)
+    return (bt["latency_us"] + bt["control_us"]) / (
+        bs["latency_us"] + bs["control_us"]
+    )
+
+
+def _phase_sample(spec, nbytes, base, true, n=8, source="self"):
+    """A residual sample predicted under ``base`` but measured as if the
+    host obeyed ``true`` — breakdown attached, the per-phase fit's diet."""
+    return ResidualSample(
+        topo=spec, world=n, codec="f32", sharded=False, nbytes=nbytes,
+        predicted_us=predict_spec_us(spec, n, nbytes, base),
+        measured_us=predict_spec_us(spec, n, nbytes, true),
+        fingerprint="fp", source=source,
+        predicted_breakdown=_breakdown(spec, nbytes, base, n),
+    )
+
+
+class TestPhaseScaleFit:
+    def test_scale_params_scales_each_phase_exactly(self):
+        scaled = fb.scale_params(TRUE, {"fixed": 3.0, "bytes": 0.25,
+                                        "codec": None})
+        for nb in (1 << 14, 1 << 20):
+            base = fb.predict_spec_cost("4,2", 8, nb, TRUE)
+            got = fb.predict_spec_cost("4,2", 8, nb, scaled)
+            assert got.latency_us == pytest.approx(3.0 * base.latency_us)
+            assert got.control_us == pytest.approx(3.0 * base.control_us)
+            assert got.bandwidth_us == pytest.approx(0.25 * base.bandwidth_us)
+            assert got.reduce_us == pytest.approx(0.25 * base.reduce_us)
+
+    def test_fit_recovers_known_phase_scales(self):
+        # measured = 2x fixed + 0.5x bytes of the predicted breakdowns,
+        # over rows whose mix varies enough to separate the phases
+        rows = []
+        for nb in (1 << 12, 1 << 16, 1 << 20, 1 << 22):
+            b = _breakdown("8", nb, TRUE)
+            f = b["latency_us"] + b["control_us"]
+            by = b["bandwidth_us"] + b["reduce_us"]
+            rows.append((f, by, 0.0, 2.0 * f + 0.5 * by))
+        scales, meta = fb.fit_phase_scales(rows)
+        assert scales["fixed"] == pytest.approx(2.0, rel=1e-6)
+        assert scales["bytes"] == pytest.approx(0.5, rel=1e-6)
+        assert scales["codec"] is None
+
+    def test_unidentifiable_phase_is_dropped_not_invented(self):
+        # bytes contribution ~zero in every row: its scale cannot be
+        # fitted — the solve must keep the base constants for it (None)
+        # and say so, not hand back a sign-flipped correction
+        rows = [
+            (100.0, 1e-9, 0.0, 250.0 + eps)
+            for eps in (0.0, 1.0, -1.0, 0.5)
+        ]
+        scales, meta = fb.fit_phase_scales(rows)
+        assert scales["fixed"] == pytest.approx(2.5, rel=0.05)
+        assert scales["bytes"] is None
+        assert "bytes" in meta.get("unresolved_phases", ())
+
+    def test_golden_bandwidth_skew_attributes_to_bytes(self):
+        # golden fixture: the host's wire is 4x slower than predicted,
+        # everything else matches — attribution must name the byte phase
+        import dataclasses
+
+        slow_wire = dataclasses.replace(
+            TRUE,
+            ici=LinkParams(
+                bandwidth_GBps=TRUE.ici.bandwidth_GBps / 4.0,
+                latency_us=TRUE.ici.latency_us,
+            ),
+            dcn=LinkParams(
+                bandwidth_GBps=TRUE.dcn.bandwidth_GBps / 4.0,
+                latency_us=TRUE.dcn.latency_us,
+            ),
+            reduce_bw_GBps=TRUE.reduce_bw_GBps / 4.0,
+        )
+        samples = [
+            _phase_sample(spec, nb, TRUE, slow_wire)
+            for spec in ("8", "4,2")
+            for nb in (1 << 14, 1 << 18, 1 << 22)
+        ]
+        params, meta = fb.fit_phase_scales_from_residuals(
+            samples, base_params=TRUE
+        )
+        assert meta["mode"] == "phase-scales"
+        assert str(meta["drifted_phase"]).startswith("bytes")
+        assert meta["phase_scales"]["bytes"] == pytest.approx(4.0, rel=0.05)
+        # the corrected constants price the slow wire
+        assert params.ici.bandwidth_GBps == pytest.approx(
+            slow_wire.ici.bandwidth_GBps, rel=0.05
+        )
+
+    def test_golden_launch_skew_attributes_to_fixed(self):
+        import dataclasses
+
+        slow_launch = dataclasses.replace(TRUE, launch_us=TRUE.launch_us * 5)
+        samples = [
+            _phase_sample(spec, nb, TRUE, slow_launch)
+            for spec in ("8", "4,2")
+            for nb in (1 << 14, 1 << 18, 1 << 22)
+        ]
+        _params, meta = fb.fit_phase_scales_from_residuals(
+            samples, base_params=TRUE
+        )
+        assert str(meta["drifted_phase"]).startswith("fixed")
+
+    def test_starved_phase_set_refuses(self):
+        samples = [_phase_sample("8", 1 << 16, TRUE, TRUE)]
+        with pytest.raises(FeedbackRefused, match="starved"):
+            fb.fit_phase_scales_from_residuals(samples, base_params=TRUE)
+
+    def test_fit_from_samples_reports_phase_attribution(self):
+        samples = [
+            _phase_sample(spec, nb, SKEW, TRUE)
+            for spec in ("8", "4,2", "2,2,2", "ring")
+            for nb in (1 << 16, 1 << 20)
+        ]
+        _params, meta = fit_from_samples(samples, base_params=SKEW)
+        assert "phase_scales" in meta or "phase_attribution" in meta
+
+    def test_samples_to_points_excludes_apportioned_step_samples(self):
+        probe = _phase_sample("8", 1 << 16, TRUE, TRUE, source="self")
+        step = _phase_sample("8", 1 << 16, TRUE, TRUE, source="step")
+        pts = samples_to_points([probe, step])
+        assert len(pts) == 1
+
+    def test_attribute_groups_labels_each_group(self):
+        samples = [
+            _phase_sample("8", nb, TRUE, TRUE) for nb in (1 << 14, 1 << 20)
+        ]
+        out = fb.attribute_groups(samples)
+        assert list(out) == [("8", "f32", "n8")]
+
+    def test_fit_residuals_auto_falls_back_to_phase_scales(self):
+        # one shape only: the alpha-beta geometry guard refuses, the
+        # phase fallback still answers (with the refusal on record)
+        samples = [
+            _phase_sample("8", nb, TRUE, TRUE)
+            for nb in (1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22)
+        ]
+        _params, meta = fb.fit_residuals_auto(samples, base_params=TRUE)
+        assert meta["mode"] == "phase-scales"
+        assert "alpha_beta_refused" in meta
+
+
+class TestFitProbeFree:
+    def _plan_rows(self, base, true, floor, sizes_counts, noise=0.0):
+        from flextree_tpu.obs.stepclock import StepSample
+
+        rng = np.random.default_rng(0)
+        samples = []
+        for sig, (nb, k) in sizes_counts.items():
+            b = _breakdown("8", nb, base)
+            t = _breakdown("8", nb, true)
+            fixed = (b["latency_us"] + b["control_us"]) * k
+            byts = (b["bandwidth_us"] + b["reduce_us"]) * k
+            comm = sum(t.values()) * k
+            for step in range(3):
+                jitter = 1.0 + (rng.uniform(-noise, noise) if noise else 0.0)
+                samples.append(StepSample(
+                    step=step, step_us=(floor + comm) * jitter,
+                    plan_sig=sig, fixed_us=fixed, bytes_us=byts,
+                    codec_us=0.0, predicted_us=fixed + byts,
+                ))
+        return samples
+
+    def test_intercept_mode_recovers_scales_and_floor(self):
+        floor = 30_000.0
+        plans = {"A": (1 << 14, 64), "B": (1 << 17, 8), "C": (1 << 20, 1)}
+        samples = self._plan_rows(SKEW, TRUE, floor, plans)
+        params, meta = fb.fit_probe_free(
+            samples, base_params=SKEW, compute_floor_us=floor
+        )
+        assert meta["mode"] == "probe-free"
+        assert meta["submode"] == "intercept"
+        # fixed scale = TRUE/SKEW LUMPED fixed-phase ratio (launch and
+        # hop latency scale as one phase, keeping the base split — the
+        # documented honest limit), recovered from in-regime variation
+        want = _fixed_phase_ratio()
+        assert meta["phase_scales"]["fixed"] == pytest.approx(want, rel=0.2)
+        # the corrected model predicts the TRUE per-bucket fixed cost
+        bt = _breakdown("8", 1 << 17, TRUE)
+        bf = _breakdown("8", 1 << 17, params)
+        assert bf["latency_us"] + bf["control_us"] == pytest.approx(
+            bt["latency_us"] + bt["control_us"], rel=0.2
+        )
+        # the implied floor is consistent with the supplied one
+        assert meta["floor_implied_us"] == pytest.approx(floor, rel=0.2)
+
+    def test_refuses_single_plan(self):
+        floor = 30_000.0
+        samples = self._plan_rows(SKEW, TRUE, floor, {"A": (1 << 14, 64)})
+        with pytest.raises(FeedbackRefused, match="plans"):
+            fb.fit_probe_free(
+                samples, base_params=SKEW, compute_floor_us=floor
+            )
+
+    def test_refuses_without_floor(self):
+        samples = self._plan_rows(
+            SKEW, TRUE, 1000.0, {"A": (1 << 14, 64), "B": (1 << 20, 1)}
+        )
+        with pytest.raises(FeedbackRefused, match="compute_floor_us"):
+            fb.fit_probe_free(
+                samples, base_params=SKEW, compute_floor_us=None
+            )
+
+    def test_noisy_floor_cannot_poison_the_fixed_fit(self):
+        # the twin-measured floor is 40% high: the byte split absorbs the
+        # error (clamped), the fixed scale still comes from in-regime
+        # step differences
+        floor = 30_000.0
+        plans = {"A": (1 << 14, 64), "B": (1 << 17, 8), "C": (1 << 20, 1)}
+        samples = self._plan_rows(SKEW, TRUE, floor, plans)
+        params, meta = fb.fit_probe_free(
+            samples, base_params=SKEW, compute_floor_us=floor * 1.4
+        )
+        want = _fixed_phase_ratio()
+        assert meta["phase_scales"]["fixed"] == pytest.approx(want, rel=0.2)
+
+
+class TestDriftPooling:
+    def _sample(self, rel, fp="fp"):
+        return ResidualSample(
+            topo="8", world=8, codec="f32", sharded=False, nbytes=1 << 16,
+            predicted_us=100.0 * (1 + rel), measured_us=100.0,
+            fingerprint=fp,
+        )
+
+    def test_summary_shape(self):
+        det = DriftDetector(band=0.5, min_window=2)
+        det.observe(self._sample(2.0))
+        det.observe(self._sample(2.0))
+        summ = det.summary()
+        (key, ent), = summ.items()
+        assert "fp|8|tree|f32|False" == key
+        assert ent["count"] == 2 and ent["median"] == pytest.approx(2.0)
+        json.dumps(summ)  # ack payload: must be JSON-safe
+
+    def test_follower_breach_pools_in(self):
+        det = DriftDetector(band=0.5, min_window=4)
+        # local window: quiet, and too thin to breach alone
+        det.observe(self._sample(0.1))
+        peers = {
+            1: {"fp|8|tree|f32|False": {"median": 2.0, "count": 9}},
+        }
+        pooled = det.pooled_breaches(peers)
+        assert pooled == {"fp|8|tree|f32|False": pytest.approx(2.0)}
+        # and without the peer there is no breach
+        assert det.pooled_breaches({}) == {}
+
+    def test_noisy_minority_rank_cannot_outvote(self):
+        det = DriftDetector(band=0.5, min_window=2)
+        for _ in range(8):
+            det.observe(self._sample(0.05))
+        peers = {1: {"fp|8|tree|f32|False": {"median": 5.0, "count": 2}}}
+        assert det.pooled_breaches(peers) == {}
+
+
+class TestProbeFreeController:
+    """The drift-without-probes pin: a mis-calibrated controller detects,
+    rotates, and refits purely from per-step spans — the probe timer is
+    armed to EXPLODE if the probe path ever runs."""
+
+    def _capture(self, nb, k, params):
+        b = _breakdown("8", nb, params)
+        prov = {
+            "axes": ["dp"], "topo": {"dp": "8"}, "world": {"dp": 8},
+            "nbytes": nb, "codec": "f32", "sharded": False,
+            "predicted": b, "predicted_us": sum(b.values()),
+        }
+        return [(f"ft_bucket{i}_dp_{nb}B", dict(prov)) for i in range(k)]
+
+    def _true_step_us(self, nb, k, floor):
+        return floor + k * predict_spec_us("8", 8, nb, TRUE)
+
+    def test_probe_free_detect_rotate_refit(self, tmp_path):
+        calib = tmp_path / "CALIB.json"
+        floor = 50_000.0
+        total = 1 << 20
+        rotations: list[int] = []
+        replans: list[str] = []
+
+        def on_rotate(bb):
+            rotations.append(int(bb))
+            return ("rotated-step", None, None)
+
+        def on_replan(plan, params):
+            replans.append(plan.to_ft_topo())
+            return ("replanned-step", None, None)
+
+        ctl = FeedbackController(
+            8, total,
+            FeedbackConfig(
+                every_k=3, band=0.5, window=8, min_window=2,
+                probe_free=True, compute_floor_us=floor,
+                rotation_cycles=1, min_steps_per_plan=2,
+                calibration_path=str(calib), backend="cpu",
+                plan_cache_path=str(tmp_path / "cache.json"),
+                on_rotate=on_rotate, on_replan=on_replan,
+            ),
+            params=SKEW,
+            timer=lambda probes, n: (_ for _ in ()).throw(
+                AssertionError("probe timer ran in probe-free mode")
+            ),
+        )
+        cur_nb, k = 1 << 14, 64
+        final = None
+        with flight_recorder(tmp_path / "obs", 0):
+            ctl.set_step_plan(self._capture(cur_nb, k, SKEW))
+            for step in range(1, 60):
+                ctl.observe_step(step, self._true_step_us(cur_nb, k, floor) * 1e-6)
+                dec = ctl.maybe_tick(step)
+                if dec is None:
+                    continue
+                if dec.rotation:
+                    assert dec.plan is None
+                    assert dec.rebuilt == ("rotated-step", None, None)
+                    cur_nb = rotations[-1]
+                    k = max(1, total // cur_nb)
+                    ctl.set_step_plan(self._capture(cur_nb, k, SKEW))
+                else:
+                    final = dec
+                    break
+        assert final is not None, (
+            f"no refit fired (rotations={rotations}, "
+            f"refusals={ctl.refusals})"
+        )
+        assert final.rebuilt == ("replanned-step", None, None)
+        assert replans and ctl.refits == 1
+        # rotation visited variants AND re-visited the base size
+        assert len(rotations) >= 3 and (1 << 14) in rotations
+        # the refit is persisted with probe-free provenance
+        doc = json.loads(calib.read_text())
+        sec = doc["cpu"]
+        assert sec["source"] == "feedback"
+        assert sec["meta"]["fit"]["mode"] == "probe-free"
+        assert sec["meta"]["fit"]["phase_scales"]["fixed"] is not None
+        # the recovered fixed constants moved toward the truth (lumped
+        # fixed-phase ratio; launch/latency split keeps the base ratio)
+        want = _fixed_phase_ratio()
+        got = ctl.params.launch_us / SKEW.launch_us
+        assert got == pytest.approx(want, rel=0.3)
+        # and the flight record shows zero dedicated probes
+        from flextree_tpu.obs.timeline import read_dir
+
+        events, _ = read_dir(str(tmp_path / "obs"))
+        assert not [e for e in events if e.get("axis") == "ftfb"]
+        assert [e for e in events if e.get("kind") == "feedback_rotate"]
+        assert [
+            e for e in events
+            if e.get("kind") == "bucket_measured" and e.get("per_step")
+        ]
+
+    def test_no_rotation_hook_refuses_once(self, tmp_path):
+        ctl = FeedbackController(
+            8, 1 << 20,
+            FeedbackConfig(
+                every_k=2, band=0.5, min_window=2, probe_free=True,
+                compute_floor_us=1000.0,
+            ),
+            params=SKEW,
+            timer=lambda p, n: (_ for _ in ()).throw(AssertionError()),
+        )
+        with flight_recorder(tmp_path / "obs", 0):
+            ctl.set_step_plan(self._capture(1 << 14, 64, SKEW))
+            for step in range(1, 12):
+                ctl.observe_step(
+                    step, self._true_step_us(1 << 14, 64, 1000.0) * 1e-6
+                )
+                assert ctl.maybe_tick(step) is None
+        assert ctl.refusals == 1  # logged once, not per tick
+
+    def test_recorder_off_probe_free_is_one_check(self):
+        ctl = FeedbackController(
+            8, 1 << 20,
+            FeedbackConfig(probe_free=True, compute_floor_us=1.0),
+            params=SKEW,
+            timer=lambda p, n: (_ for _ in ()).throw(AssertionError()),
+        )
+        assert not ctl.wants_step_spans()
+        ctl.observe_step(1, 0.01)  # no recorder: must be inert
+        assert len(ctl.step_clock.samples) == 0
+        assert ctl.maybe_tick(50) is None
+        assert ctl.ticks == 0

@@ -649,3 +649,310 @@ class TestServingTelemetry:
         assert (
             report["replica_metrics"][0]["counters"]["serve.finished"] >= 1
         )
+
+
+# -------------------------------------- per-step span clock (ISSUE 15)
+
+
+def _prov(nb=4096, pred=None):
+    pred = pred or {
+        "latency_us": 30.0, "bandwidth_us": 8.0, "reduce_us": 2.0,
+        "control_us": 1.0, "codec_us": 0.0,
+    }
+    return {
+        "axes": ["dp"], "topo": {"dp": "8"}, "world": {"dp": 8},
+        "nbytes": nb, "codec": "f32", "sharded": False,
+        "predicted": pred, "predicted_us": sum(pred.values()),
+    }
+
+
+class TestPlanCapture:
+    def test_capture_collects_provenance_spans_only(self):
+        from flextree_tpu.utils.profiling import comm_span, plan_capture
+
+        with plan_capture() as cap:
+            with comm_span("ft_bucket0_dp_4096B", provenance=_prov()):
+                pass
+            with comm_span("bare_span_128B"):
+                pass
+        assert [name for name, _ in cap] == ["ft_bucket0_dp_4096B"]
+
+    def test_nested_captures_both_record(self):
+        from flextree_tpu.utils.profiling import comm_span, plan_capture
+
+        with plan_capture() as outer:
+            with plan_capture() as inner:
+                with comm_span("ft_bucket0_dp_4096B", provenance=_prov()):
+                    pass
+        assert len(outer) == 1 and len(inner) == 1
+
+
+class TestStepSpanClock:
+    def test_plan_from_capture_groups_phases(self):
+        from flextree_tpu.obs.stepclock import plan_from_capture
+
+        plan = plan_from_capture(
+            [("b0", _prov(4096)), ("b1", _prov(8192)),
+             ("bad", {"predicted_error": True})]
+        )
+        assert len(plan.buckets) == 2
+        assert plan.fixed_us == pytest.approx(2 * 31.0)
+        assert plan.bytes_us == pytest.approx(2 * 10.0)
+        assert plan.predicted_us == pytest.approx(2 * 41.0)
+
+    def test_plan_sig_distinguishes_bucket_sizes(self):
+        from flextree_tpu.obs.stepclock import plan_from_capture
+
+        a = plan_from_capture([("b", _prov(4096))])
+        b = plan_from_capture([("b", _prov(8192))])
+        assert a.sig != b.sig
+
+    def test_first_step_per_plan_is_dropped_as_compile(self, tmp_path):
+        from flextree_tpu.obs.stepclock import StepSpanClock
+
+        clock = StepSpanClock(compute_floor_us=100.0)
+        clock.set_plan([("b", _prov())])
+        assert clock.observe_step(0, 0.01) is None  # the compiling call
+        assert clock.observe_step(1, 0.01) is not None
+        clock.set_plan([("b", _prov(8192))])  # re-compile: drop again
+        assert clock.observe_step(2, 0.01) is None
+        assert clock.dropped_first == 2
+
+    def test_events_carry_pairing_keys_and_breakdowns(self, tmp_path):
+        from flextree_tpu.obs.stepclock import StepSpanClock
+
+        with flight_recorder(tmp_path, rank=0):
+            clock = StepSpanClock(compute_floor_us=1000.0, fingerprint="fp")
+            clock.set_plan([("b0", _prov(4096)), ("b1", _prov(8192))])
+            clock.observe_step(0, 0.002)
+            clock.observe_step(1, 0.002)  # 2000us: comm = 1000us
+        events, _ = read_dir(str(tmp_path))
+        step_evs = [e for e in events if e["kind"] == "step_measured"]
+        buck_evs = [e for e in events if e["kind"] == "bucket_measured"]
+        assert len(step_evs) == 1 and len(buck_evs) == 2
+        assert step_evs[0]["comm_us"] == pytest.approx(1000.0, rel=0.01)
+        for ev in buck_evs:
+            assert ev["per_step"] is True and ev["apportioned"] is True
+            assert ev["topo"] == {"dp": "8"} and ev["world"] == {"dp": 8}
+            assert isinstance(ev["predicted"], dict)
+            assert ev["fingerprint"] == "fp"
+        # equal predictions -> equal apportioned shares
+        assert buck_evs[0]["measured_us"] == pytest.approx(
+            buck_evs[1]["measured_us"]
+        )
+        assert sum(e["measured_us"] for e in buck_evs) == pytest.approx(
+            1000.0, rel=0.01
+        )
+
+    def test_provisional_floor_tracks_quietest_step(self):
+        from flextree_tpu.obs.stepclock import StepSpanClock
+
+        clock = StepSpanClock()  # no configured floor
+        clock.set_plan([("b", _prov())])  # predicted_us = 41
+        clock.observe_step(0, 0.001)
+        assert clock.floor_us is None  # compile dropped: no evidence yet
+        clock.observe_step(1, 0.002)
+        clock.observe_step(2, 0.001)
+        # floor = min(step_us - predicted) = 1000 - 41
+        assert clock.floor_us == pytest.approx(1000.0 - 41.0, rel=0.01)
+
+
+class TestStepMeasuredTimeline:
+    def test_per_step_measured_spans_pair_with_plan_spans(self):
+        prov = _prov(4096)
+        events = [
+            {"ts": 1.0, "rank": 0, "seq": 0, "kind": "bucket_planned",
+             "name": "ft_bucket0_dp_4096B", **prov},
+            {"ts": 2.0, "rank": 0, "seq": 1, "kind": "bucket_measured",
+             "name": "ft_bucket0_dp_4096B", "topo": {"dp": "8"},
+             "world": {"dp": 8}, "nbytes": 4096, "codec": "f32",
+             "sharded": False, "measured_us": 55.0, "predicted_us": 41.0,
+             "predicted": prov["predicted"], "per_step": True,
+             "apportioned": True, "step": 3},
+            {"ts": 3.0, "rank": 0, "seq": 2, "kind": "step_measured",
+             "step": 3, "step_us": 2000.0, "floor_us": 1000.0,
+             "comm_us": 1000.0, "predicted_us": 41.0, "plan_sig": "ab",
+             "n_buckets": 1},
+        ]
+        doc = merge_events(events)
+        assert validate_trace(doc) == []
+        plan = [e for e in doc["traceEvents"] if e.get("cat") == "comm-plan"]
+        meas = [e for e in doc["traceEvents"]
+                if e.get("cat") == "comm-measured"]
+        step = [e for e in doc["traceEvents"]
+                if e.get("cat") == "step-measured"]
+        assert len(plan) == len(meas) == len(step) == 1
+        # the pairing: same name, same rank track, measured span carries
+        # the prediction + per-phase breakdown in its args
+        assert meas[0]["name"] == plan[0]["name"]
+        assert meas[0]["pid"] == plan[0]["pid"] == 0
+        assert meas[0]["dur"] == pytest.approx(55.0)
+        assert meas[0]["args"]["predicted_us"] == 41.0
+        assert isinstance(meas[0]["args"]["predicted"], dict)
+        assert step[0]["dur"] == pytest.approx(2000.0)
+
+    def test_serve_round_measured_renders_as_span(self):
+        events = [
+            {"ts": 1.0, "rank": 0, "seq": 0, "kind": "serve_round_measured",
+             "round": 4, "n_active": 3, "max_len": 40,
+             "measured_us": 900.0, "predicted_us": 700.0,
+             "compute_us": 600.0, "bytes_us": 100.0},
+        ]
+        doc = merge_events(events)
+        assert validate_trace(doc) == []
+        spans = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "serve-measured"]
+        assert len(spans) == 1 and spans[0]["dur"] == pytest.approx(900.0)
+
+    def test_residual_pairs_tags_step_source_and_breakdown(self):
+        prov = _prov(4096)
+        events = [
+            {"ts": 1.0, "rank": 0, "seq": 0, "kind": "bucket_planned",
+             "name": "b", **prov},
+            {"ts": 2.0, "rank": 0, "seq": 1, "kind": "bucket_measured",
+             "topo": {"dp": "8"}, "world": {"dp": 8}, "nbytes": 4096,
+             "codec": "f32", "sharded": False, "measured_us": 55.0,
+             "predicted_us": 41.0, "predicted": prov["predicted"],
+             "per_step": True},
+        ]
+        from flextree_tpu.obs.timeline import residual_pairs
+
+        samples, _skipped = residual_pairs(events)
+        assert len(samples) == 1
+        assert samples[0].source == "step"
+        assert samples[0].phases == {
+            "fixed": pytest.approx(31.0),
+            "bytes": pytest.approx(10.0),
+            "codec": pytest.approx(0.0),
+        }
+
+
+class TestPrometheusExposition:
+    def test_counters_gauges_histograms(self):
+        from flextree_tpu.obs.metrics import (
+            MetricsRegistry,
+            prometheus_exposition,
+        )
+
+        reg = MetricsRegistry()
+        reg.counter("serve.finished").inc(3)
+        reg.gauge("serve.free_blocks").set(17)
+        h = reg.windowed_histogram(
+            "serve.ttft_ms", buckets=(1.0, 10.0, 100.0), interval_s=1.0,
+            intervals=4,
+        )
+        for v in (0.5, 5.0, 50.0, 50.0):
+            h.observe(v, now=100.0)
+        text = prometheus_exposition({"0": reg.snapshot()})
+        assert "# TYPE flextree_serve_finished counter" in text
+        assert 'flextree_serve_finished{rank="0"} 3' in text
+        assert 'flextree_serve_free_blocks{rank="0"} 17' in text
+        assert "# TYPE flextree_serve_ttft_ms histogram" in text
+        # cumulative buckets, not per-bucket counts
+        assert 'flextree_serve_ttft_ms_bucket{rank="0",le="1.0"} 1' in text
+        assert 'flextree_serve_ttft_ms_bucket{rank="0",le="10.0"} 2' in text
+        assert 'flextree_serve_ttft_ms_bucket{rank="0",le="100.0"} 4' in text
+        assert 'flextree_serve_ttft_ms_bucket{rank="0",le="+Inf"} 4' in text
+        assert 'flextree_serve_ttft_ms_count{rank="0"} 4' in text
+        # the windowed SLO view is scrapeable as a gauge
+        assert "flextree_serve_ttft_ms_window_count" in text
+
+    def test_name_sanitization(self):
+        from flextree_tpu.obs.metrics import _prom_name
+
+        assert _prom_name("serve.ttft_ms") == "flextree_serve_ttft_ms"
+        assert _prom_name("a-b/c d") == "flextree_a_b_c_d"
+
+    def test_metrics_cli_prom(self, tmp_path, capsys):
+        from flextree_tpu.obs.__main__ import main
+        from flextree_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("train.steps").inc(5)
+        (tmp_path / "metrics_0.json").write_text(json.dumps(reg.snapshot()))
+        assert main(["metrics", str(tmp_path), "--prom"]) == 0
+        out = capsys.readouterr().out
+        assert 'flextree_train_steps{rank="0"} 5' in out
+        assert main(["metrics", str(tmp_path)]) == 0
+        assert "train.steps" in capsys.readouterr().out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert main(["metrics", str(empty), "--prom"]) == 1
+
+
+class TestResidualsCLIFilters:
+    def _write_events(self, dir, fingerprint, spec="8", sizes=(4096, 65536)):
+        os.makedirs(dir, exist_ok=True)
+        with open(os.path.join(dir, "flight_0.jsonl"), "w") as f:
+            for i, nb in enumerate(sizes):
+                pred = {
+                    "latency_us": 30.0, "bandwidth_us": nb / 1000.0,
+                    "reduce_us": nb / 4000.0, "control_us": 1.0,
+                    "codec_us": 0.0,
+                }
+                ev = {
+                    "ts": float(i), "rank": 0, "seq": i,
+                    "kind": "bucket_measured", "topo": {"dp": spec},
+                    "world": {"dp": 8}, "nbytes": nb, "codec": "f32",
+                    "sharded": False, "measured_us": sum(pred.values()) * 2,
+                    "predicted_us": sum(pred.values()), "predicted": pred,
+                    "fingerprint": fingerprint,
+                }
+                f.write(json.dumps(ev) + "\n")
+
+    def test_json_and_fingerprint_filter(self, tmp_path, capsys):
+        from flextree_tpu.obs.__main__ import main
+
+        self._write_events(str(tmp_path), "fpA")
+        assert main(["residuals", str(tmp_path), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["samples"]) == 2
+        assert doc["samples"][0]["phases"]["fixed"] == pytest.approx(31.0)
+        assert main(
+            ["residuals", str(tmp_path), "--fingerprint", "nope", "--json"]
+        ) == 0
+        assert json.loads(capsys.readouterr().out)["samples"] == []
+
+    def test_table_has_phase_columns(self, tmp_path, capsys):
+        from flextree_tpu.obs.__main__ import main
+
+        self._write_events(str(tmp_path), "fpA")
+        assert main(["residuals", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "phases f/b/c" in out and "drift" in out
+
+    def test_fleet_pools_across_dirs(self, tmp_path, capsys):
+        from flextree_tpu.obs.__main__ import main
+
+        # each run alone is one shape at two sizes (refuses to fit);
+        # pooled across shapes the phase fit answers
+        sizes = (4096, 65536, 1 << 20)
+        self._write_events(str(tmp_path / "r0"), "fp", spec="8", sizes=sizes)
+        self._write_events(str(tmp_path / "r1"), "fp", spec="4,2",
+                           sizes=sizes)
+        rc = main(["fleet", str(tmp_path / "r0"), str(tmp_path / "r1"),
+                   "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["pooled"]["fp"]["condition"] is not None
+        assert doc["pooled"]["fp"]["samples"] == 6
+        assert doc["pooled"]["fp"]["runs"] == 2
+
+    def test_fleet_fit_out_persists_calibration(self, tmp_path, capsys):
+        from flextree_tpu.obs.__main__ import main
+
+        sizes = (4096, 65536, 1 << 20)
+        self._write_events(str(tmp_path / "r0"), "fp", spec="8", sizes=sizes)
+        self._write_events(str(tmp_path / "r1"), "fp", spec="4,2",
+                           sizes=sizes)
+        out_path = tmp_path / "CAL.json"
+        rc = main([
+            "fleet", str(tmp_path / "r0"), str(tmp_path / "r1"),
+            "--fit-out", str(out_path), "--backend", "cpu", "--json",
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        doc = json.loads(out_path.read_text())
+        assert doc["cpu"]["source"] == "feedback"
+        assert doc["cpu"]["fingerprint"] == "fp"
+        assert doc["cpu"]["meta"]["fleet"]["samples"] == 6
