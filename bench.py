@@ -1011,6 +1011,51 @@ def run_coordination_tripwire(timeout_s: int = 600) -> dict:
             pass
 
 
+def run_rpc_chaos_tripwire(timeout_s: int = 600) -> dict:
+    """Supplementary key ``rpc_chaos_violations`` — the real-process
+    serving front door exercised end-to-end on this exact tree (ISSUE 16;
+    0 = a replica SIGKILL'd mid-decode loses no request and forks no
+    sequence, every torn response frame is CRC-caught and replayed from
+    the idempotency store, and an intake spike sheds loudly with every
+    rid accounted).
+
+    Runs ``tools/rpc_chaos.py --smoke`` in a subprocess (real replica
+    processes behind real TCP; the full matrix with the SIGTERM drain and
+    the hedging A/B lives in the committed RPC_CHAOS.json); a driver that
+    fails to run reports ``rpc_chaos_error`` with the key absent — absent
+    reads as "not verified", never as "clean".
+    """
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "rpc_chaos.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        violations = sum(
+            0 if s.get("ok") else 1 for s in doc["scenarios"].values()
+        )
+        out = {"rpc_chaos_violations": violations}
+        if p.returncode != 0 and not violations:
+            out["rpc_chaos_error"] = f"rpc_chaos rc={p.returncode}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"rpc_chaos_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -1086,6 +1131,7 @@ def main() -> int:
         result.update(run_probe_free_tripwire())
         result.update(run_arbiter_tripwire())
         result.update(run_coordination_tripwire())
+        result.update(run_rpc_chaos_tripwire())
     print(json.dumps(result))
     return 0
 

@@ -19,6 +19,13 @@ benchmarked in throughput and latency percentiles instead of step time:
   heartbeat/lease membership over replicas, a ``StepWatchdog`` deadline
   around each scheduling round, and drain/re-route off dead replicas so
   the pool degrades instead of failing.
+- :mod:`.rpc` / :mod:`.replica_main` / :mod:`.frontdoor` — the
+  real-process tier: a CRC-trailered frame protocol over TCP, a replica
+  server process per engine (heartbeat-registered, SIGTERM-drainable),
+  and a front-door router with deadlines, bounded retries, windowed-p99
+  hedging, circuit breakers, and load shedding — exactly-once results
+  via replica-side idempotency, proven under kill chaos by
+  ``tools/rpc_chaos.py`` → ``RPC_CHAOS.json``.
 
 Measured artifact: ``tools/bench_serving.py`` → ``BENCH_SERVING.json``
 (open-loop Poisson load; machine-checked floors).  Design notes and the
@@ -33,6 +40,12 @@ from .batcher import (
     SeqState,
 )
 from .engine import CompletedRequest, ServingEngine
+from .frontdoor import (
+    FrontDoor,
+    FrontDoorConfig,
+    FrontDoorResult,
+    ReplicaClient,
+)
 from .kv_cache import (
     NULL_BLOCK,
     BlockAllocator,
@@ -46,6 +59,15 @@ from .kv_cache import (
     write_swapped,
 )
 from .pool import PoolConfig, ReplicaFailed, ReplicaPool
+from .replica_main import ReplicaConfig, ReplicaServer
+from .rpc import (
+    RpcConnection,
+    RpcConnRefused,
+    RpcError,
+    RpcShed,
+    RpcTimeout,
+    RpcTornFrame,
+)
 
 __all__ = [
     "NULL_BLOCK",
@@ -68,4 +90,16 @@ __all__ = [
     "PoolConfig",
     "ReplicaFailed",
     "ReplicaPool",
+    "RpcError",
+    "RpcTimeout",
+    "RpcConnRefused",
+    "RpcTornFrame",
+    "RpcShed",
+    "RpcConnection",
+    "ReplicaConfig",
+    "ReplicaServer",
+    "FrontDoor",
+    "FrontDoorConfig",
+    "FrontDoorResult",
+    "ReplicaClient",
 ]

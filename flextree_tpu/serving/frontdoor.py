@@ -1,0 +1,599 @@
+"""The fault-tolerant front door: route, retry, hedge, shed, account.
+
+The client side of the real-process serving stack (:mod:`.replica_main`
+is the server side, :mod:`.rpc` the wire).  One :class:`FrontDoor` owns
+the request lifecycle from intake to exactly-once result:
+
+- **discovery** — replicas are found through the shared control dir:
+  ``rpc_{rank:05d}.json`` endpoint files (CRC-trailered) say where to
+  connect, the Supervisor heartbeats say who is HEALTHY / STRAGGLER /
+  DEAD (:class:`~flextree_tpu.runtime.supervisor.MembershipView`) — the
+  same membership the training stack replans from;
+- **routing** — healthy replicas first, least-outstanding among them
+  (the pool's ``_route`` rule, now over processes), circuit-breaker
+  strike-out per replica (``breaker_strikes`` consecutive transport
+  failures open it for ``breaker_cooldown_s``);
+- **deadlines** — every request has one total budget from its arrival
+  stamp; the wire carries the *remaining* budget (monotonic clocks have
+  no cross-process epoch), and a replica refuses an already-expired
+  request instead of executing it;
+- **retries** — bounded exponential backoff on the typed transport
+  failures (``FT_RPC_TIMEOUT`` / ``FT_RPC_CONN_REFUSED`` /
+  ``FT_RPC_TORN_FRAME``) and on replica-side sheds; a ``drain`` refusal
+  re-routes immediately (the replica is leaving, not failing);
+- **hedging** — when an attempt is still outstanding after the windowed
+  p99 of recent attempt latencies (times ``hedge_factor``), a duplicate
+  attempt goes to a *different* replica and the first result wins.  Safe
+  by construction: the replica-side idempotency store computes each rid
+  once, so the loser is a wasted RPC, never a forked sequence;
+- **shedding** — over ``shed_outstanding`` requests in flight, intake
+  refuses loudly (``serve.shed`` + a ``serve_shed`` flight event) rather
+  than queueing into a latency cliff;
+- **exactly-once results** — ``completed`` is first-writer-wins under a
+  lock; a hedge race's second result increments
+  ``serve.duplicate_results`` and is dropped.
+
+TTFT is stamped ONCE at intake (:meth:`FrontDoor.submit`): however many
+retries, hedges, and re-routes a request suffers, its reported TTFT is
+``(winning attempt's send - arrival) + the replica's queue-to-first-
+token time`` — queue and retry time included, the PR 9 stamping rule
+extended across the wire.  Per-replica windowed TTFT histograms (and
+the retry/hedge/shed/drain counters) export through
+``obs metrics DIR --prom`` via :meth:`write_metrics`.
+
+Clocks (``_now``) and backoff sleeps (``_sleep``) are module-level
+injectables, same pattern as ``engine._now`` / ``supervisor._wall``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..obs import MetricsRegistry, record_event
+from ..runtime.ctrlfile import read_control_json
+from ..runtime.supervisor import DEAD, HEALTHY, MembershipView
+from ..utils.logging import get_logger
+from .rpc import (
+    RpcConnection,
+    RpcConnRefused,
+    RpcError,
+    RpcShed,
+    RpcTimeout,
+)
+
+__all__ = ["FrontDoorConfig", "FrontDoorResult", "ReplicaClient", "FrontDoor"]
+
+log = get_logger("flextree.serving")
+
+# injection points for tests (patch these, not time.*)
+_now = time.monotonic
+_sleep = time.sleep
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontDoorConfig:
+    """Knobs, grouped by mechanism (defaults sized for localhost chaos;
+    a real DCN wants every timeout an order of magnitude up)."""
+
+    # deadlines
+    request_timeout_s: float = 30.0  # total budget per request
+    attempt_timeout_s: float = 4.0  # one RPC's budget (capped by request)
+    connect_timeout_s: float = 1.0
+    # retries
+    max_attempts: int = 8  # total launches per rid, hedges included
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 1.0
+    # hedging
+    hedge_factor: float = 2.0  # delay = factor x windowed-p99 attempt
+    hedge_min_samples: int = 8  # no p99, no hedging (cold start)
+    hedge_floor_s: float = 0.05  # never hedge tighter than this
+    max_hedges: int = 1  # per attempt round; 0 disables (the twin)
+    # breaker
+    breaker_strikes: int = 3
+    breaker_cooldown_s: float = 2.0
+    # shedding
+    shed_outstanding: int = 64
+    # workers + membership thresholds (match SupervisorConfig defaults)
+    dispatchers: int = 4
+    straggler_s: float = 1.0
+    lease_s: float = 3.0
+    slo_window_s: float = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontDoorResult:
+    """One exactly-once result as the client sees it."""
+
+    rid: int
+    tokens: np.ndarray
+    ttft_s: float  # arrival -> first token, queue + retries included
+    rank: int  # the replica whose attempt won
+    attempts: int  # launches it took (1 = clean first try)
+    hedged: bool
+
+
+class ReplicaClient:
+    """Front-door state for one replica process: endpoint, connection,
+    outstanding count, breaker, and its own windowed-TTFT registry."""
+
+    def __init__(self, rank: int, cfg: FrontDoorConfig):
+        self.rank = rank
+        self.cfg = cfg
+        self.host: str | None = None
+        self.port: int | None = None
+        self.pid: int | None = None
+        self.conn: RpcConnection | None = None
+        self.outstanding = 0
+        self.strikes = 0
+        self.open_until = 0.0  # breaker-open horizon on the _now clock
+        self.registry = MetricsRegistry()
+        self.registry.windowed_histogram(
+            "serve.ttft_ms", interval_s=cfg.slo_window_s / 10.0, intervals=10
+        )
+        self._lock = threading.Lock()
+
+    def update_endpoint(self, host: str, port: int, pid: int) -> None:
+        if (host, port, pid) != (self.host, self.port, self.pid):
+            # a replaced process (same rank, new pid/port): drop the old
+            # connection, the next attempt dials the new endpoint
+            if self.conn is not None:
+                self.conn.close()
+            self.conn = None
+            self.host, self.port, self.pid = host, port, pid
+
+    def connection(self) -> RpcConnection:
+        with self._lock:
+            if self.conn is not None and self.conn.dead is None:
+                return self.conn
+            if self.host is None or self.port is None:
+                raise RpcConnRefused(f"rank {self.rank}: no endpoint")
+            self.conn = RpcConnection.connect(
+                self.host, self.port, timeout_s=self.cfg.connect_timeout_s
+            )
+            return self.conn
+
+    # breaker ----------------------------------------------------------------
+
+    def breaker_open(self, now: float) -> bool:
+        return now < self.open_until
+
+    def strike(self, now: float, registry: MetricsRegistry) -> None:
+        self.strikes += 1
+        if self.strikes >= self.cfg.breaker_strikes:
+            self.open_until = now + self.cfg.breaker_cooldown_s
+            self.strikes = 0
+            registry.counter("serve.breaker_opens").inc()
+            record_event(
+                "breaker_open", peer=self.rank,
+                cooldown_s=self.cfg.breaker_cooldown_s,
+            )
+
+    def clear_strikes(self) -> None:
+        self.strikes = 0
+
+    def close(self) -> None:
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+
+class FrontDoor:
+    """Route requests to replica processes; deliver exactly-once results.
+
+    Usage::
+
+        fd = FrontDoor(ctrl_dir, FrontDoorConfig()).start()
+        for r in requests:
+            fd.submit(r.rid, r.prompt, r.max_new_tokens)
+        fd.wait_idle(timeout_s=60)
+        fd.completed[rid].tokens  # np.int32, bitwise vs generate
+        fd.close()
+    """
+
+    def __init__(self, dir: str, cfg: FrontDoorConfig | None = None):
+        self.dir = dir
+        self.cfg = cfg or FrontDoorConfig()
+        self.metrics = MetricsRegistry()
+        self.metrics.windowed_histogram(
+            "serve.ttft_ms",
+            interval_s=self.cfg.slo_window_s / 10.0, intervals=10,
+        )
+        # attempt latency drives the hedge trigger: a WINDOWED p99 so a
+        # quiet hour ago can't mask a straggler now
+        self.metrics.windowed_histogram(
+            "serve.attempt_ms",
+            interval_s=self.cfg.slo_window_s / 10.0, intervals=10,
+        )
+        self.membership = MembershipView(
+            dir, straggler_s=self.cfg.straggler_s, lease_s=self.cfg.lease_s
+        )
+        self.clients: dict[int, ReplicaClient] = {}
+        self.completed: dict[int, FrontDoorResult] = {}
+        self.failed: dict[int, str] = {}  # rid -> FT_RPC_* code
+        self.shed_rids: list[int] = []  # intake refusals, accounted
+        self._arrival: dict[int, float] = {}  # rid -> intake stamp (once)
+        self._attempt_seq: dict[int, int] = {}
+        self._inflight: set[int] = set()
+        self._lock = threading.Lock()
+        self._work: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FrontDoor":
+        for i in range(self.cfg.dispatchers):
+            t = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name=f"ft-frontdoor-{i}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        for _ in self._threads:
+            self._work.put(None)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        for client in self.clients.values():
+            client.close()
+
+    # ---- discovery ---------------------------------------------------------
+
+    def refresh(self) -> None:
+        """Re-read the endpoint files; a torn or missing file simply
+        leaves that rank unroutable until its writer finishes."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for name in sorted(names):
+            if not (name.startswith("rpc_") and name.endswith(".json")):
+                continue
+            ep = read_control_json(os.path.join(self.dir, name))
+            if ep is None:
+                continue
+            try:
+                rank = int(ep["rank"])
+                host, port, pid = ep["host"], int(ep["port"]), int(ep["pid"])
+            except (KeyError, ValueError, TypeError):
+                continue
+            client = self.clients.get(rank)
+            if client is None:
+                client = self.clients[rank] = ReplicaClient(rank, self.cfg)
+            client.update_endpoint(host, port, pid)
+
+    def _routable(self, exclude=()) -> "ReplicaClient | None":
+        """Healthy first, then stragglers; least-outstanding within the
+        tier; DEAD and breaker-open replicas never."""
+        self.refresh()
+        states = {r: s.state for r, s in self.membership.poll().items()}
+        now = _now()
+        tiers: dict[str, list[ReplicaClient]] = {"healthy": [], "other": []}
+        for rank, client in self.clients.items():
+            if rank in exclude or client.breaker_open(now):
+                continue
+            state = states.get(rank)
+            if state == DEAD:
+                continue
+            key = "healthy" if state in (None, HEALTHY) else "other"
+            tiers[key].append(client)
+        for tier in (tiers["healthy"], tiers["other"]):
+            if tier:
+                return min(tier, key=lambda c: (c.outstanding, c.rank))
+        return None
+
+    # ---- intake ------------------------------------------------------------
+
+    def submit(self, rid: int, prompt, max_new_tokens: int) -> bool:
+        """Queue one request.  The arrival stamp is written exactly once
+        here — a retried / hedged / re-routed request keeps it, so TTFT
+        includes every queue and recovery second.  Returns False on an
+        intake shed (accounted, never silently dropped)."""
+        with self._lock:
+            inflight = len(self._inflight)
+            if inflight >= self.cfg.shed_outstanding:
+                self.metrics.counter("serve.shed").inc()
+                self.shed_rids.append(rid)
+                record_event(
+                    "serve_shed", rid=rid, where="frontdoor",
+                    inflight=inflight, reason="FT_RPC_SHED",
+                )
+                return False
+            self._arrival.setdefault(rid, _now())
+            self._inflight.add(rid)
+        self._work.put(
+            (rid, np.asarray(prompt, np.int32), int(max_new_tokens))
+        )
+        return True
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._inflight
+
+    def wait_idle(self, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.idle:
+                return True
+            time.sleep(0.01)
+        return self.idle
+
+    # ---- the dispatch machinery --------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._work.get()
+            if item is None:
+                return
+            rid, prompt, max_new = item
+            try:
+                self._execute(rid, prompt, max_new)
+            finally:
+                with self._lock:
+                    self._inflight.discard(rid)
+
+    def _next_attempt(self, rid: int) -> int:
+        with self._lock:
+            n = self._attempt_seq.get(rid, 0)
+            self._attempt_seq[rid] = n + 1
+            return n
+
+    def _attempts_used(self, rid: int) -> int:
+        with self._lock:
+            return self._attempt_seq.get(rid, 0)
+
+    def _hedge_delay_s(self) -> float | None:
+        """``hedge_factor`` x the windowed p99 of attempt latency, once
+        enough samples exist; None disables hedging this round."""
+        if self.cfg.max_hedges <= 0:
+            return None
+        hist = self.metrics.windowed_histogram("serve.attempt_ms")
+        if hist.window_count() < self.cfg.hedge_min_samples:
+            return None
+        p99_s = hist.window_percentile(0.99) / 1e3
+        return max(self.cfg.hedge_floor_s, self.cfg.hedge_factor * p99_s)
+
+    def _launch_attempt(
+        self, client: ReplicaClient, payload: dict, timeout_s: float,
+        resq: queue.Queue,
+    ) -> None:
+        """Fire one RPC on its own thread; the outcome (ok / typed error)
+        lands on ``resq``.  Outstanding accounting is per replica and
+        released whatever happens."""
+        client.outstanding += 1
+
+        def _run():
+            send_mono = _now()
+            try:
+                conn = client.connection()
+                reply = conn.call(payload, timeout_s=timeout_s)
+            except RpcError as e:
+                resq.put(("err", e, client, send_mono))
+            else:
+                resq.put(("ok", reply, client, send_mono))
+            finally:
+                client.outstanding -= 1
+
+        threading.Thread(
+            target=_run, daemon=True, name="ft-frontdoor-attempt"
+        ).start()
+
+    def _execute(self, rid: int, prompt: np.ndarray, max_new: int) -> None:
+        cfg = self.cfg
+        arrival = self._arrival[rid]
+        deadline = arrival + cfg.request_timeout_s
+        backoff = cfg.backoff_base_s
+        avoid: set = set()  # ranks that drain-refused this rid
+        while True:
+            now = _now()
+            if now >= deadline:
+                self._fail(rid, RpcTimeout.code)
+                return
+            if self._attempts_used(rid) >= cfg.max_attempts:
+                self._fail(rid, "FT_RPC_RETRIES")
+                return
+            client = self._routable(exclude=avoid)
+            if client is None and avoid:
+                # everyone left has drain-refused us: better a draining
+                # replica (it may still be up) than nobody
+                avoid.clear()
+                client = self._routable()
+            if client is None:
+                # nobody routable right now (all dead / breaker-open):
+                # back off inside the budget and look again
+                _sleep(min(backoff, max(0.0, deadline - _now())))
+                backoff = min(backoff * 2.0, cfg.backoff_cap_s)
+                continue
+            verdict = self._attempt_round(
+                rid, prompt, max_new, client, deadline
+            )
+            kind = verdict[0]
+            if kind == "done":
+                return
+            if kind == "drain":
+                # the replica is leaving, not failing: re-route at once,
+                # and not back to the drainer
+                avoid.add(verdict[1])
+                self.metrics.counter("serve.drains").inc()
+                record_event("serve_drain_reroute", rid=rid,
+                             peer=verdict[1])
+                continue
+            # transport failure or replica shed: count a retry, back off
+            self.metrics.counter("serve.retries").inc()
+            record_event(
+                "serve_retry", rid=rid, code=verdict[1],
+                attempts=self._attempts_used(rid),
+            )
+            _sleep(min(backoff, max(0.0, deadline - _now())))
+            backoff = min(backoff * 2.0, cfg.backoff_cap_s)
+
+    def _attempt_round(
+        self, rid, prompt, max_new, client: ReplicaClient, deadline: float
+    ):
+        """One primary attempt plus up to ``max_hedges`` hedges; first
+        usable outcome wins.  Returns ``("done",)``, ``("drain", rank)``
+        or ``("retry", code)``."""
+        cfg = self.cfg
+        resq: queue.Queue = queue.Queue()
+        hedged = False
+        outstanding = 0
+        tried = []
+
+        def _fire(target: ReplicaClient):
+            nonlocal outstanding
+            attempt = self._next_attempt(rid)
+            remaining = deadline - _now()
+            payload = {
+                "kind": "generate",
+                "rid": rid,
+                "attempt": attempt,
+                "prompt": [int(t) for t in prompt],
+                "max_new_tokens": max_new,
+                "deadline_in_s": round(remaining, 6),
+            }
+            timeout = min(cfg.attempt_timeout_s, max(remaining, 1e-3))
+            self._launch_attempt(target, payload, timeout, resq)
+            tried.append(target.rank)
+            outstanding += 1
+
+        _fire(client)
+        hedge_delay = self._hedge_delay_s()
+        hedges = 0
+        last_code = RpcTimeout.code
+        while outstanding:
+            remaining = deadline - _now()
+            if remaining <= 0:
+                return ("retry", RpcTimeout.code)
+            wait = remaining
+            if hedge_delay is not None and hedges < cfg.max_hedges:
+                wait = min(wait, hedge_delay)
+            try:
+                kind, payload, rep, send_mono = resq.get(timeout=wait)
+            except queue.Empty:
+                if hedge_delay is not None and hedges < cfg.max_hedges:
+                    twin = self._routable(exclude=tried)
+                    if twin is not None and (
+                        self._attempts_used(rid) < cfg.max_attempts
+                    ):
+                        hedges += 1
+                        hedged = True
+                        self.metrics.counter("serve.hedges").inc()
+                        record_event(
+                            "serve_hedge", rid=rid, primary=client.rank,
+                            hedge=twin.rank,
+                            delay_ms=round(hedge_delay * 1e3, 3),
+                        )
+                        _fire(twin)
+                        continue
+                    # nobody to hedge to: wait out the primary
+                    hedge_delay = None
+                continue
+            outstanding -= 1
+            if kind == "err":
+                err: RpcError = payload
+                last_code = err.code
+                rep.strike(_now(), self.metrics)
+                continue  # a hedge twin may still deliver
+            self.metrics.histogram("serve.attempt_ms").observe(
+                (_now() - send_mono) * 1e3
+            )
+            reply = payload
+            if reply.get("drain"):
+                return ("drain", rep.rank)
+            if not reply.get("ok"):
+                code = reply.get("code", "FT_RPC_ERROR")
+                last_code = code
+                if code == RpcShed.code:
+                    record_event("serve_shed_upstream", rid=rid,
+                                 peer=rep.rank)
+                continue
+            rep.clear_strikes()
+            self._deliver(rid, reply, rep, send_mono, hedged)
+            return ("done",)
+        return ("retry", last_code)
+
+    # ---- results -----------------------------------------------------------
+
+    def _deliver(
+        self, rid: int, reply: dict, client: ReplicaClient,
+        send_mono: float, hedged: bool,
+    ) -> None:
+        """First writer wins; a hedge race's loser is counted, dropped."""
+        arrival = self._arrival[rid]
+        ttft_s = (send_mono - arrival) + float(reply["ttft_s"])
+        result = FrontDoorResult(
+            rid=rid,
+            tokens=np.asarray(reply["tokens"], np.int32),
+            ttft_s=ttft_s,
+            rank=int(reply["rank"]),
+            attempts=self._attempts_used(rid),
+            hedged=hedged,
+        )
+        with self._lock:
+            if rid in self.completed:
+                self.metrics.counter("serve.duplicate_results").inc()
+                record_event("serve_duplicate_result", rid=rid,
+                             peer=client.rank)
+                return
+            self.completed[rid] = result
+        self.metrics.counter("serve.completed").inc()
+        self.metrics.histogram("serve.ttft_ms").observe(ttft_s * 1e3)
+        client.registry.histogram("serve.ttft_ms").observe(ttft_s * 1e3)
+        record_event(
+            "serve_result", rid=rid, peer=result.rank,
+            attempts=result.attempts, hedged=hedged,
+            ttft_ms=round(ttft_s * 1e3, 3), n_tokens=len(result.tokens),
+        )
+
+    def _fail(self, rid: int, code: str) -> None:
+        with self._lock:
+            if rid in self.completed:
+                return
+            self.failed[rid] = code
+        self.metrics.counter("serve.failed").inc()
+        record_event("serve_failed", rid=rid, code=code)
+
+    # ---- export ------------------------------------------------------------
+
+    def snapshots(self) -> dict:
+        """Label -> registry snapshot: the front door's aggregate plus
+        one per replica (front-door-observed TTFT — queue and retries
+        included, the SLO the client actually experiences)."""
+        out = {"frontdoor": self.metrics.snapshot()}
+        for rank, client in sorted(self.clients.items()):
+            out[f"fd_{rank:05d}"] = client.registry.snapshot()
+        return out
+
+    def prometheus(self) -> str:
+        from ..obs import prometheus_exposition
+
+        return prometheus_exposition(self.snapshots())
+
+    def write_metrics(self, dir: str | None = None) -> list:
+        """Drop ``metrics_frontdoor.json`` + ``metrics_fd_{rank}.json``
+        into the control dir so ``obs metrics DIR --prom`` exports the
+        per-replica windowed TTFT-p99 gauges and the retry / hedge /
+        shed / drain counters next to the replica processes' own
+        snapshots."""
+        import json
+
+        dir = dir or self.dir
+        paths = []
+        for label, snap in self.snapshots().items():
+            path = os.path.join(dir, f"metrics_{label}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snap, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+            paths.append(path)
+        return paths

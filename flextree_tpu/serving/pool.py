@@ -37,8 +37,14 @@ the same timeout-vs-death escalation ``fit`` uses, tilted toward safety.
 
 Replicas here are in-process objects (the pool is single-host, like the
 chaos harness's launcher); the heartbeat protocol is already
-cross-process, so promoting replicas to real processes is transport work,
-not a redesign — the named follow-up in ROADMAP.md.
+cross-process, so promoting replicas to real processes was transport
+work, not a redesign — that tier now exists: :mod:`.replica_main` runs
+one engine per real OS process behind the :mod:`.rpc` frame protocol,
+and :mod:`.frontdoor` re-implements this pool's route/drain/exactly-once
+rules over TCP with deadlines, retries, hedging, and circuit breakers
+(proven under kill chaos by ``tools/rpc_chaos.py`` → ``RPC_CHAOS.json``).
+This in-process pool remains the zero-serialization single-host fast
+path and the reference semantics the RPC tier is held to.
 """
 
 from __future__ import annotations
