@@ -736,6 +736,89 @@ def run_paged_tripwire(timeout_s: int = 900) -> dict:
             pass
 
 
+def start_prefix_tripwire():
+    """Launch ``tools/bench_prefix.py --smoke`` WITHOUT blocking (it pins
+    its own CPU backend).  The prefix smoke is pure CPU work with no
+    timing floors of its own, so it runs concurrently with the other
+    tripwires and its cost hides inside their sleep windows (chaos kill
+    waits, lease windows, hedging timeouts) — on the single-core CI
+    runner that is the only way adding a tripwire does not push bench.py
+    past the contract test's subprocess budget.  Returns an opaque handle
+    for ``collect_prefix_tripwire`` (or an error dict if the launch
+    itself failed, which collect passes through)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        proc = subprocess.Popen(
+            [
+                sys.executable, os.path.join(REPO, "tools", "bench_prefix.py"),
+                "--smoke", "--out", report_path,
+            ],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, cwd=REPO,
+        )
+    except OSError as e:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+        return {"prefix_error": f"{type(e).__name__}: {e}"[:200]}
+    return (proc, report_path)
+
+
+def collect_prefix_tripwire(handle, timeout_s: int = 900) -> dict:
+    """Supplementary keys ``prefix_cache_bitwise_violations`` (warm-index
+    engine output vs the cold engine and contiguous ``generate`` on the
+    shared-prompt workload, plus the unique-prompt negative control;
+    0 = every hit was byte-for-byte honest) and
+    ``prefix_tokens_saved_frac`` (fraction of prompt tokens served from
+    cached blocks instead of recomputed — the >= 0.5 floor and the TTFT
+    floor are enforced in the full run committed as BENCH_PREFIX.json;
+    smoke reports them).  Joins the subprocess ``start_prefix_tripwire``
+    launched and reads its artifact.  Absent keys read as "not
+    verified", never as "clean"."""
+    if isinstance(handle, dict):  # launch already failed
+        return handle
+    proc, report_path = handle
+    try:
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            return {"prefix_error": f"timeout after {timeout_s}s"}
+        floors = json.load(open(report_path, encoding="utf-8"))["floors"]
+        violations = (
+            floors["prefix_cache_bitwise_violations"]
+            + int(not floors["hit_rate_ok"])
+            + int(not floors["leak_ok"])
+            + int(not floors["negative_control_ok"])
+        )
+        out = {
+            "prefix_cache_bitwise_violations": violations,
+            "prefix_tokens_saved_frac": floors["prefix_tokens_saved_frac"],
+            # informational in smoke: the enforced TTFT floor lives in
+            # the committed full-run BENCH_PREFIX.json
+            "prefix_hit_ttft_ratio": floors["hit_ttft_ratio"],
+        }
+        if rc != 0:
+            out["prefix_error"] = f"bench_prefix rc={rc}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"prefix_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
+def run_prefix_tripwire(timeout_s: int = 900) -> dict:
+    """Blocking form of the prefix tripwire (launch + collect)."""
+    return collect_prefix_tripwire(start_prefix_tripwire(), timeout_s)
+
+
 _OBS_TRIPWIRE_CODE = r'''
 import json, os, sys, tempfile, time
 sys.path.insert(0, {repo!r})
@@ -1119,6 +1202,8 @@ def main() -> int:
     except Exception:
         pass
     if result.get("metric") != "bench_error":
+        # prefix smoke overlaps with everything below; joined at the end
+        prefix_handle = start_prefix_tripwire()
         result.update(run_static_analysis_tripwire())
         result.update(run_runtime_report_tripwire())
         result.update(run_quantize_tripwire())
@@ -1132,6 +1217,7 @@ def main() -> int:
         result.update(run_arbiter_tripwire())
         result.update(run_coordination_tripwire())
         result.update(run_rpc_chaos_tripwire())
+        result.update(collect_prefix_tripwire(prefix_handle))
     print(json.dumps(result))
     return 0
 

@@ -44,6 +44,7 @@ from .transformer import (
 __all__ = [
     "init_kv_cache",
     "prefill",
+    "prefill_suffix",
     "prefill_ragged",
     "decode_step",
     "generate",
@@ -141,6 +142,59 @@ def prefill(params, tokens, cfg: TransformerConfig, max_len: int):
         raise ValueError(f"prompt length {t} exceeds max_len {max_len}")
     cache = init_kv_cache(cfg, b, max_len)
     logits, cache = _forward_cached(params, tokens, cache, 0, cfg)
+    return logits[:, -1], cache
+
+
+def prefill_suffix(params, tokens, prefix_kv, cfg: TransformerConfig,
+                   max_len: int):
+    """Suffix-only prefill over an already-computed prefix: run ONLY the
+    ``tokens`` (B, Ts) that follow a cached prefix whose per-layer K/V is
+    ``prefix_kv = {"k": [(B, C, H, Dh)], "v": [...]}``.  Returns
+    ``(last_logits, cache)`` exactly like :func:`prefill` of the full
+    ``C + Ts`` prompt would.
+
+    Offset-aware by construction: RoPE positions and the causal mask
+    start at the cached length ``C`` (the prefix shape carries it, so it
+    is static per compile — one program per (C, Ts) bucket), and the
+    cache writes land at ``C ..`` so cached positions are never
+    rewritten.  Bitwise identity with the full prefill follows from two
+    facts the paged stack already leans on: a prefix position's K/V is a
+    pure function of the prefix tokens (absolute positions, causal
+    masking), and every masked cache slot contributes exactly 0.0 —
+    so the suffix queries attend over the very same values, in the same
+    ``max_len``-wide reduction, full prefill's suffix rows see.
+
+    Caveat the batcher's admission math honors: a ONE-token suffix puts
+    the attention matmuls in the ``Tq=1`` shape class, which XLA lowers
+    with a different accumulation order than the multi-row prefill —
+    numerically fine, but not bitwise against the full prefill.  Callers
+    that need the bitwise guarantee must pass at least two suffix
+    tokens.
+    """
+    b, t = tokens.shape
+    ks = prefix_kv["k"]
+    if len(ks) != cfg.n_layers or len(prefix_kv["v"]) != cfg.n_layers:
+        raise ValueError(
+            f"prefix_kv holds {len(ks)} layers, model has {cfg.n_layers}"
+        )
+    c = int(ks[0].shape[1])
+    if t < 1:
+        raise ValueError("prefill_suffix needs at least one suffix token "
+                         "(the last prompt token's logits come from it)")
+    if c + t > max_len:
+        raise ValueError(
+            f"cached {c} + suffix {t} exceeds max_len {max_len}"
+        )
+    cache = init_kv_cache(cfg, b, max_len)
+    cache["k"] = [
+        kc.at[:, :c].set(pk.astype(kc.dtype))
+        for kc, pk in zip(cache["k"], prefix_kv["k"])
+    ]
+    cache["v"] = [
+        vc.at[:, :c].set(pv.astype(vc.dtype))
+        for vc, pv in zip(cache["v"], prefix_kv["v"])
+    ]
+    logits, cache = _forward_cached(params, tokens, cache, c, cfg)
     return logits[:, -1], cache
 
 

@@ -291,6 +291,15 @@ def merge_events(events, dumps: dict[int, dict] | None = None) -> dict:
                 trace.append({**flow, "ph": "t"})
             continue
 
+        if kind.startswith("serve_prefix"):
+            # rid-less prefix-cache events (``serve_prefix_evict``) still
+            # belong on the serve lane, not the generic fallback
+            trace.append(
+                {"name": kind, "cat": "serve", "ph": "i", "s": "t",
+                 **common, "args": _args(ev)}
+            )
+            continue
+
         if kind in _ARBITER_KINDS:
             # process-scoped instants: a chip reallocation concerns every
             # lane of the track, not one thread's local moment

@@ -15,6 +15,13 @@ benchmarked in throughput and latency percentiles instead of step time:
 - :mod:`.engine` — one serving replica: paged pool + batcher + the two
   jitted programs, with per-request greedy/temperature/top-k sampling and
   TTFT / per-token timestamps on an injectable clock.
+- :mod:`.prefix_index` — the cross-request prefix cache: a radix trie
+  over prompt token ids at block granularity, refcounted copy-on-write
+  sharing of full prompt blocks, LRU eviction under pool pressure, and
+  suffix-only prefill on a hit — bitwise-identical to a cold engine
+  (``tools/bench_prefix.py`` → ``BENCH_PREFIX.json``).  The front door
+  routes by prefix affinity so shared prompts land where their blocks
+  already are.
 - :mod:`.pool` — the elastic replica pool: ``runtime.Supervisor``
   heartbeat/lease membership over replicas, a ``StepWatchdog`` deadline
   around each scheduling round, and drain/re-route off dead replicas so
@@ -56,9 +63,11 @@ from .kv_cache import (
     make_paged_decode_fn,
     paged_decode_step,
     write_prefill,
+    write_prefill_at,
     write_swapped,
 )
 from .pool import PoolConfig, ReplicaFailed, ReplicaPool
+from .prefix_index import PrefixIndex, PrefixIndexError
 from .replica_main import ReplicaConfig, ReplicaServer
 from .rpc import (
     RpcConnection,
@@ -76,6 +85,7 @@ __all__ = [
     "PagedCacheConfig",
     "init_pools",
     "write_prefill",
+    "write_prefill_at",
     "write_swapped",
     "paged_decode_step",
     "make_paged_decode_fn",
@@ -85,6 +95,8 @@ __all__ = [
     "PreemptedSeq",
     "BatcherConfig",
     "ContinuousBatcher",
+    "PrefixIndex",
+    "PrefixIndexError",
     "ServingEngine",
     "CompletedRequest",
     "PoolConfig",

@@ -49,6 +49,16 @@ running sequences keep decoding on the next step).
 frees the slot and returns every held block to the allocator
 immediately — freed blocks resume preempted sequences or admit queued
 requests on the very next step.
+
+**Prefix cache** (``BatcherConfig.prefix_cache``, off by default): a
+:class:`~flextree_tpu.serving.prefix_index.PrefixIndex` shares full
+prompt blocks across requests.  Admission matches the longest cached
+block-aligned prefix, RETAINS those blocks instead of allocating, and
+the engine prefills only the suffix; retirement inserts the sequence's
+full prompt blocks into the index and RELEASES everything it held;
+pool pressure evicts idle index entries before blocking admission.
+Still a pure host-side state machine: the index stores token ids and
+block ids, never tensors.
 """
 
 from __future__ import annotations
@@ -59,6 +69,7 @@ from collections import deque
 import numpy as np
 
 from .kv_cache import BlockAllocator, CacheExhausted, PagedCacheConfig, NULL_BLOCK
+from .prefix_index import PrefixIndex
 
 __all__ = [
     "Request",
@@ -105,6 +116,14 @@ class SeqState:
     done_s: float = 0.0
     admit_seq: int = 0  # monotonic admission stamp: victim = largest
     preempts: int = 0  # times this sequence was preempted
+    # prefix-cache admission state: how many leading cache positions came
+    # from the index (the engine prefills only the rest), how many leading
+    # block_ids are SHARED (retained, never written by this sequence), and
+    # the shared source of a copy-on-write fork — the engine gathers the
+    # mid-block prefix from it, then releases it
+    cached_tokens: int = 0
+    shared_blocks: int = 0
+    cow_src: int | None = None
 
     @property
     def rid(self) -> int:
@@ -135,12 +154,19 @@ class BatcherConfig:
     bytes to host memory; resume is a scatter, bit-identical by
     construction) or ``"recompute"`` (drop the K/V, replay
     prompt+generated through prefill on resume — cheaper for short
-    contexts, pays forward FLOPs and a per-length compile)."""
+    contexts, pays forward FLOPs and a per-length compile).
+    ``prefix_cache``: enable the cross-request prefix index — admission
+    shares cached full-block prefixes and prefills only the suffix;
+    retirement releases blocks into the index instead of freeing them
+    (off by default: a warm index intentionally keeps retired prompt
+    blocks out of the free list, which changes the pool-accounting
+    invariants callers may assert)."""
 
     slots: int = 4
     max_prefill_tokens_per_step: int = 256
     admission: str = "reserve"
     preempt: str = "swap"
+    prefix_cache: bool = False
 
     def __post_init__(self):
         if self.admission not in ("reserve", "ondemand"):
@@ -154,6 +180,10 @@ class ContinuousBatcher:
         self.pcfg = pcfg
         self.bcfg = bcfg
         self.allocator = BlockAllocator(pcfg.num_blocks)
+        self.prefix_index = (
+            PrefixIndex(pcfg.block_size, self.allocator)
+            if bcfg.prefix_cache else None
+        )
         self.slots: list = [None] * bcfg.slots
         self.queue: deque = deque()
         self.preempted: deque = deque()  # PreemptedSeq, resume-first FIFO
@@ -215,13 +245,73 @@ class ContinuousBatcher:
         self._admit_seq += 1
         return self._admit_seq
 
+    def _alloc_with_evict(self, n: int) -> list:
+        """Allocate ``n`` blocks, evicting idle prefix-index entries
+        under pool pressure first (LRU, index-only holders) — live
+        sequences always outrank cold cache."""
+        try:
+            return self.allocator.alloc(n)
+        except CacheExhausted:
+            if self.prefix_index is None:
+                raise
+            self.prefix_index.evict(n - self.allocator.num_free)
+            return self.allocator.alloc(n)
+
+    def _match_prefix(self, req: Request):
+        """Look up the longest cached block-aligned prefix for ``req``.
+
+        Returns ``(shared, cow_src, cached_tokens)``: the leading block
+        ids to share outright (retained here), the shared block to
+        copy-on-write fork when the cached chain reaches past them (its
+        tail positions must be re-derived into a private copy — never
+        written in place in the shared original), and how many leading
+        cache positions the engine's prefill may skip.
+
+        A hit always leaves at least TWO suffix tokens: the last prompt
+        token must run through the model for its logits regardless, and
+        a one-token suffix would put the attention matmuls in the
+        ``Tq=1`` shape class, which XLA lowers with a different
+        accumulation order than the multi-row prefill — breaking the
+        bitwise identity the whole cache rests on.  So shared blocks are
+        capped at ``(prompt_len - 2) // block_size`` and a full-chain
+        hit re-derives the final two positions (the second-to-last one
+        landing mid-block in the COW fork)."""
+        if self.prefix_index is None:
+            return [], None, 0
+        matched = self.prefix_index.match(np.asarray(req.prompt))
+        if not matched:
+            return [], None, 0
+        bs = self.pcfg.block_size
+        n_shared = min(len(matched), (req.prompt_len - 2) // bs)
+        shared = matched[:n_shared]
+        cow_src = matched[n_shared] if len(matched) > n_shared else None
+        cached = (
+            req.prompt_len - 2 if cow_src is not None else n_shared * bs
+        )
+        if cached <= 0:
+            return [], None, 0
+        self.allocator.retain(shared)
+        if cow_src is not None:
+            # hold the fork source until the engine has gathered its
+            # bytes — an eviction between admission and prefill would
+            # otherwise hand the suffix prefill a recycled block
+            self.allocator.retain([cow_src])
+        return shared, cow_src, cached
+
     def try_admit(self, now_s: float = 0.0) -> list:
         """Admit queued requests into free slots under the block and
         prefill-token budgets.  Returns ``[(slot_idx, SeqState), ...]``
         for the engine to prefill; the states are already resident (the
         allocation happened here — all-or-nothing per request).  Sets
         ``admit_blocked`` when the queue head is blocked on BLOCKS (not
-        slots) — the engine's ``serve_admit_blocked`` signal."""
+        slots) — the engine's ``serve_admit_blocked`` signal.
+
+        With the prefix cache on, the queue head's longest cached
+        block-aligned prefix is shared (retained) instead of allocated,
+        only the SUFFIX blocks are taken from the free list, and the
+        prefill-token budget is charged for the suffix alone — a cache
+        hit is cheap to admit in exactly the proportion it is cheap to
+        prefill."""
         if self.preempted:
             # resume-first, strictly: fresh admissions must not take the
             # blocks a half-done preempted sequence is waiting for (and
@@ -236,24 +326,35 @@ class ContinuousBatcher:
             if not free_slots:
                 break
             req = self.queue[0]
-            if admitted and req.prompt_len > budget:
-                break  # join-at-step budget spent; next step picks it up
+            shared, cow_src, cached = self._match_prefix(req)
+            suffix_tokens = req.prompt_len - cached
+            retained = shared + ([cow_src] if cow_src is not None else [])
+            if admitted and suffix_tokens > budget:
+                # join-at-step budget spent; next step picks it up
+                self.allocator.release(retained)
+                break
             try:
-                blocks = self.allocator.alloc(self.blocks_needed(req))
+                blocks = self._alloc_with_evict(
+                    self.blocks_needed(req) - len(shared)
+                )
             except CacheExhausted as e:
                 # FIFO head-of-line: wait for retirements
+                self.allocator.release(retained)
                 self.admit_blocked = (req.rid, e.want, e.free)
                 break
             self.queue.popleft()
-            budget -= req.prompt_len
+            budget -= suffix_tokens
             state = SeqState(
                 request=req,
-                block_ids=blocks,
+                block_ids=shared + blocks,
                 length=req.prompt_len,
                 pending_token=-1,
                 generated=[],
                 admitted_s=now_s,
                 admit_seq=self._next_admit_seq(),
+                cached_tokens=cached,
+                shared_blocks=len(shared),
+                cow_src=cow_src,
             )
             slot = free_slots[0]
             self.slots[slot] = state
@@ -285,7 +386,9 @@ class ContinuousBatcher:
                 break
             pre = self.preempted[0]
             try:
-                blocks = self.allocator.alloc(self.blocks_for_resume(pre.state))
+                blocks = self._alloc_with_evict(
+                    self.blocks_for_resume(pre.state)
+                )
             except CacheExhausted as e:
                 self.admit_blocked = (pre.state.rid, e.want, e.free)
                 break
@@ -312,7 +415,7 @@ class ContinuousBatcher:
             s = self.slots[i]
             need = s.length // self.pcfg.block_size + 1
             while len(s.block_ids) < need:
-                s.block_ids.extend(self.allocator.alloc(1))
+                s.block_ids.extend(self._alloc_with_evict(1))
                 if i not in grown:
                     grown.append(i)
         return grown
@@ -329,13 +432,20 @@ class ContinuousBatcher:
         return max(active, key=lambda i: self.slots[i].admit_seq)
 
     def preempt(self, slot: int, kv=None) -> SeqState:
-        """Evict ``slot``: free every held block, park the sequence (and
-        the engine-saved ``kv``, if swapping) on the resume queue."""
+        """Evict ``slot``: release every held block (shared prefix blocks
+        just drop this holder — the index and any co-sharing sequence
+        keep them alive), park the sequence (and the engine-saved ``kv``,
+        if swapping) on the resume queue.  A resumed sequence gets
+        all-private blocks, so its sharing bookkeeping resets here."""
         s = self.slots[slot]
         if s is None:
             raise ValueError(f"slot {slot} holds no sequence")
-        self.allocator.free(s.block_ids)
+        self.allocator.release(s.block_ids)
+        if s.cow_src is not None:  # unconsumed fork source (engine never
+            self.allocator.release([s.cow_src])  # prefilled) — drop it
+            s.cow_src = None
         s.block_ids = []
+        s.shared_blocks = 0
         s.preempts += 1
         self.slots[slot] = None
         self.preempted.append(PreemptedSeq(state=s, kv=kv))
@@ -394,12 +504,29 @@ class ContinuousBatcher:
     # ---- retirement --------------------------------------------------------
 
     def retire_ready(self) -> list:
-        """Free every done slot's blocks; returns ``[(slot_idx, SeqState)]``
-        for the finished sequences."""
+        """Release every done slot's blocks; returns ``[(slot_idx,
+        SeqState)]`` for the finished sequences.
+
+        With the prefix cache on, the sequence's FULL prompt blocks
+        (``prompt_len // block_size`` of them — never the tail block
+        decode wrote into) are first inserted into the index, which
+        retains the ones it adopts; the release that follows then only
+        returns the un-adopted remainder to the free list.  A sequence
+        that was itself a cache hit walks the same trie path it was
+        admitted from, so its shared blocks are found already indexed
+        and adopted zero times."""
         finished = []
         for i, s in enumerate(self.slots):
             if s is not None and s.done:
-                self.allocator.free(s.block_ids)
+                if self.prefix_index is not None:
+                    full = s.request.prompt_len // self.pcfg.block_size
+                    self.prefix_index.insert(
+                        np.asarray(s.request.prompt), s.block_ids[:full]
+                    )
+                if s.cow_src is not None:  # defensive: engine clears this
+                    self.allocator.release([s.cow_src])
+                    s.cow_src = None
+                self.allocator.release(s.block_ids)
                 self.slots[i] = None
                 finished.append((i, s))
         return finished

@@ -92,17 +92,26 @@ def predict_decode_round_us(
     }
 
 
-def predict_prefill_us(cfg, prompt_len: int, params=None) -> float:
+def predict_prefill_us(cfg, prompt_len: int, params=None,
+                       cached_tokens: int = 0) -> float:
     """Predicted prefill compute time for one prompt (the TTFT floor a
     non-queued request could hit): dense FLOPs for every prompt token
-    plus the causal attention triangle."""
+    plus the causal attention triangle.
+
+    ``cached_tokens`` is the prefix-cache hit length: those tokens pay
+    neither dense FLOPs nor their attention rows, but the suffix still
+    attends over the FULL prefix — so the attention term is the triangle
+    minus the cached sub-triangle (``t² − c²``), not ``(t − c)²``.
+    Pricing a hit as a full prefill would poison the serving residual
+    stream the feedback loop pools."""
     from ..parallel.overlap import resolve_bwd_GFLOPs
     from ..planner.calibrate import default_params
 
     if params is None:
         params = default_params()
     t = max(int(prompt_len), 1)
-    dense = _dense_flops_per_token(cfg) * t
-    attn = 2.0 * t * t * cfg.d_model * cfg.n_layers
+    c = min(max(int(cached_tokens), 0), t - 1)
+    dense = _dense_flops_per_token(cfg) * (t - c)
+    attn = 2.0 * (t * t - c * c) * cfg.d_model * cfg.n_layers
     gflops = max(resolve_bwd_GFLOPs(params), 1e-6)
     return (dense + attn) / (gflops * 1e3)

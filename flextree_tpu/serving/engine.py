@@ -44,12 +44,13 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.generate import prefill, sample_token
+from ..models.generate import prefill, prefill_suffix, sample_token
 from ..models.transformer import TransformerConfig
 from ..obs import MetricsRegistry, record_event
 from .batcher import BatcherConfig, ContinuousBatcher, Request, SeqState
@@ -60,6 +61,7 @@ from .kv_cache import (
     init_pools,
     make_paged_decode_fn,
     write_prefill,
+    write_prefill_at,
     write_swapped,
 )
 
@@ -151,12 +153,40 @@ class ServingEngine:
         self._prefill = jax.jit(
             lambda p, tok: prefill(p, tok, cfg, max_len=pcfg.max_len)
         )
+        # suffix-only prefill for prefix-cache hits, fused with the block
+        # gather into ONE program: one compile per (chain_len, cached_len,
+        # suffix_len) bucket — the prefix shapes carry the offset, so
+        # RoPE/mask come out right with zero dynamic indexing, and the
+        # per-layer gather never round-trips through eager dispatch (which
+        # costs more than the tokens it saves at small model sizes)
+        def _hit(p, tok, pools, chain, c):
+            view = gather_seq(pools, chain, length=c)
+            return prefill_suffix(
+                p, tok,
+                {
+                    "k": [k[None] for k in view["k"]],
+                    "v": [v[None] for v in view["v"]],
+                },
+                cfg, max_len=pcfg.max_len,
+            )
+
+        self._hit_prefill = jax.jit(_hit, static_argnums=(4,))
         self._write = jax.jit(write_prefill, donate_argnums=(0,))
+        # the suffix scatter never touches blocks below start_block — the
+        # shared cached blocks stay byte-identical through a hit
+        self._write_at = jax.jit(
+            write_prefill_at, static_argnums=(3,), donate_argnums=(0,)
+        )
         self._write_back = jax.jit(write_swapped, donate_argnums=(0,))
         self._keys: dict = {}  # slot -> presplit (max_new, 2) key rows
         self.completed: dict = {}
         self.steps = 0
         self.decode_steps = 0
+        # windowed prefix hit-rate over the SLO window (admissions only;
+        # exported as a gauge so `obs metrics DIR --prom` carries it)
+        self._prefix_window: deque = deque()
+        if self.batcher.prefix_index is not None:
+            self.batcher.prefix_index.on_evict = self._on_prefix_evict
 
     # ---- intake ------------------------------------------------------------
 
@@ -394,14 +424,80 @@ class ServingEngine:
             params = self._cost_params_cache = default_params()
         return params
 
+    def _on_prefix_evict(self, block: int) -> None:
+        self.metrics.counter("serve.prefix_evictions").inc()
+        record_event("serve_prefix_evict", block=int(block))
+
+    def _note_prefix_admission(self, hit: bool, now: float) -> None:
+        """One admission's hit/miss into the windowed hit-rate gauge."""
+        w = self._prefix_window
+        w.append((now, 1 if hit else 0))
+        cutoff = now - self.slo_window_s
+        while w and w[0][0] < cutoff:
+            w.popleft()
+        self.metrics.gauge("serve.prefix_hit_rate").set(
+            sum(h for _, h in w) / len(w)
+        )
+
+    def release_prefix_cache(self) -> int:
+        """Drop every index-held block reference (the drain/leak-check
+        path: afterwards the free list must be whole again once no
+        sequences are resident).  Returns how many entries were
+        released."""
+        idx = self.batcher.prefix_index
+        return idx.clear() if idx is not None else 0
+
     def _prefill_slot(self, slot: int, state: SeqState) -> None:
         t0 = _now()
         req = state.request
-        prompt = np.asarray(req.prompt, np.int32)[None]
-        logits, cache = self._prefill(self.params, prompt)
-        self.pools = self._write(
-            self.pools, cache, np.asarray(state.block_ids, np.int32)
-        )
+        prompt = np.asarray(req.prompt, np.int32)
+        c = state.cached_tokens
+        if c > 0:
+            bs = self.pcfg.block_size
+            # the prefix K/V lives in the shared blocks — plus, for a
+            # full-prompt hit, the COW fork's SOURCE (the fresh fork
+            # destination in block_ids holds garbage until the scatter
+            # below fills it with the same bytes)
+            chain = list(state.block_ids[: state.shared_blocks])
+            if state.cow_src is not None:
+                chain.append(state.cow_src)
+            logits, cache = self._hit_prefill(
+                self.params, prompt[None, c:], self.pools,
+                np.asarray(chain, np.int32), c,
+            )
+            # scatter ONLY from the first non-shared block onward: the
+            # cache's positions there are the gathered prefix bytes (for
+            # the COW fork's mid-block head) plus the freshly computed
+            # suffix K/V; the shared blocks below are never rewritten
+            sb = c // bs
+            self.pools = self._write_at(
+                self.pools, cache,
+                np.asarray(state.block_ids[sb:], np.int32), sb,
+            )
+            if state.cow_src is not None:
+                self.metrics.counter("serve.prefix_cow").inc()
+                record_event(
+                    "serve_prefix_cow", rid=req.rid,
+                    src=int(state.cow_src), dst=int(state.block_ids[sb]),
+                )
+                self.batcher.allocator.release([state.cow_src])
+                state.cow_src = None
+            self.metrics.counter("serve.prefix_hits").inc()
+            self.metrics.counter("serve.cached_tokens_saved").inc(c)
+            record_event(
+                "serve_prefix_hit", rid=req.rid, cached_tokens=c,
+                shared_blocks=state.shared_blocks,
+                suffix_tokens=req.prompt_len - c,
+            )
+        else:
+            logits, cache = self._prefill(self.params, prompt[None])
+            self.pools = self._write(
+                self.pools, cache, np.asarray(state.block_ids, np.int32)
+            )
+            if self.batcher.prefix_index is not None:
+                self.metrics.counter("serve.prefix_misses").inc()
+        if self.batcher.prefix_index is not None:
+            self._note_prefix_admission(c > 0, t0)
         if req.temperature > 0:
             if req.seed is None:  # unreachable via submit(); guard direct use
                 raise ValueError(
@@ -422,11 +518,12 @@ class ServingEngine:
 
         record_event(
             "serve_prefill", rid=req.rid, slot=slot,
-            prompt_len=req.prompt_len,
+            prompt_len=req.prompt_len, cached_tokens=c,
             measured_us=round((now - t0) * 1e6, 3),
             predicted_us=round(
                 predict_prefill_us(
-                    self.cfg, req.prompt_len, self._cost_params()
+                    self.cfg, req.prompt_len, self._cost_params(),
+                    cached_tokens=c,
                 ),
                 3,
             ),
@@ -476,7 +573,7 @@ class ServingEngine:
 
     # ---- warmup ------------------------------------------------------------
 
-    def warmup(self, prompt_lens, block_counts=()) -> None:
+    def warmup(self, prompt_lens, block_counts=(), suffix_buckets=()) -> None:
         """Compile the decode step, each distinct prompt length's prefill,
         and each distinct reservation size's pool write before a timed run
         (compiles otherwise land inside the first requests' latency).
@@ -485,7 +582,13 @@ class ServingEngine:
         swap-in scatter is warmed for EVERY block count (a resume's count
         is ``length//bs + 1`` at whatever length eviction struck — one
         scatter compile per count, and an unwarmed one lands inside the
-        preemption stall it is supposed to be ending)."""
+        preemption stall it is supposed to be ending).
+        ``suffix_buckets``: ``(cached_len, suffix_len)`` pairs the
+        prefix-cache workload will hit — suffix prefill compiles per
+        distinct pair (the prefix shape carries the offset), and an
+        unwarmed bucket puts its compile inside the very TTFT the cache
+        hit was supposed to shrink.  Each bucket also warms the offset
+        scatter for every remaining-block count it can need."""
         S, P = self.bcfg.slots, self.pcfg.blocks_per_seq
         jax.block_until_ready(
             self._decode(
@@ -541,5 +644,30 @@ class ServingEngine:
                         init_pools(self.cfg, self.pcfg),
                         {"k": zeros, "v": zeros},
                         np.arange(1, n + 1, dtype=np.int32),
+                    )["k"][0]
+                )
+        bs = self.pcfg.block_size
+        for c, s in sorted(set((int(c), int(s)) for c, s in suffix_buckets)):
+            if c < 1 or s < 1:
+                # c need NOT be block-aligned: the COW case caches
+                # prompt_len - 2, which lands mid-block in the fork
+                raise ValueError(
+                    f"suffix bucket ({c}, {s}): cached_len and "
+                    f"suffix_len must both be >= 1"
+                )
+            nc = -(-c // bs)  # chain blocks covering the cached prefix
+            _, cache = self._hit_prefill(
+                self.params, np.zeros((1, s), np.int32),
+                init_pools(self.cfg, self.pcfg),
+                np.arange(1, nc + 1, dtype=np.int32), c,
+            )
+            sb = c // bs
+            for n in range(1, P - sb + 1):
+                jax.block_until_ready(
+                    self._write_at(
+                        init_pools(self.cfg, self.pcfg),
+                        cache,
+                        np.arange(1, n + 1, dtype=np.int32),
+                        sb,
                     )["k"][0]
                 )

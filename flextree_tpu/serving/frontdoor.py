@@ -52,6 +52,7 @@ import os
 import queue
 import threading
 import time
+import zlib
 
 import numpy as np
 
@@ -99,6 +100,13 @@ class FrontDoorConfig:
     breaker_cooldown_s: float = 2.0
     # shedding
     shed_outstanding: int = 64
+    # prefix affinity: requests whose first ``affinity_span`` prompt
+    # tokens hash alike PREFER the replica that last completed one (its
+    # prefix index is warm there) — a preference only, never overriding
+    # health, breaker, or drain avoidance; 0 disables.  Matches the
+    # replica default block size so the span is exactly one cacheable
+    # block.
+    affinity_span: int = 8
     # workers + membership thresholds (match SupervisorConfig defaults)
     dispatchers: int = 4
     straggler_s: float = 1.0
@@ -219,6 +227,10 @@ class FrontDoor:
         self.shed_rids: list[int] = []  # intake refusals, accounted
         self._arrival: dict[int, float] = {}  # rid -> intake stamp (once)
         self._attempt_seq: dict[int, int] = {}
+        # prefix affinity: first-block hash -> rank that last completed a
+        # request carrying it (that replica's prefix index is warm)
+        self._affinity: dict[int, int] = {}
+        self._rid_phash: dict[int, int] = {}
         self._inflight: set[int] = set()
         self._lock = threading.Lock()
         self._work: queue.Queue = queue.Queue()
@@ -271,9 +283,12 @@ class FrontDoor:
                 client = self.clients[rank] = ReplicaClient(rank, self.cfg)
             client.update_endpoint(host, port, pid)
 
-    def _routable(self, exclude=()) -> "ReplicaClient | None":
+    def _routable(self, exclude=(), prefer=None) -> "ReplicaClient | None":
         """Healthy first, then stragglers; least-outstanding within the
-        tier; DEAD and breaker-open replicas never."""
+        tier; DEAD and breaker-open replicas never.  ``prefer`` names a
+        rank to pick over the load balance IF it survives every health /
+        breaker / exclusion filter into the healthy tier — affinity is a
+        tiebreak inside the safe set, never a way back into it."""
         self.refresh()
         states = {r: s.state for r, s in self.membership.poll().items()}
         now = _now()
@@ -286,6 +301,12 @@ class FrontDoor:
                 continue
             key = "healthy" if state in (None, HEALTHY) else "other"
             tiers[key].append(client)
+        if prefer is not None:
+            for client in tiers["healthy"]:
+                if client.rank == prefer:
+                    self.metrics.counter("serve.affinity_routed").inc()
+                    return client
+            self.metrics.counter("serve.affinity_miss").inc()
         for tier in (tiers["healthy"], tiers["other"]):
             if tier:
                 return min(tier, key=lambda c: (c.outstanding, c.rank))
@@ -310,9 +331,16 @@ class FrontDoor:
                 return False
             self._arrival.setdefault(rid, _now())
             self._inflight.add(rid)
-        self._work.put(
-            (rid, np.asarray(prompt, np.int32), int(max_new_tokens))
-        )
+        p = np.asarray(prompt, np.int32)
+        span = self.cfg.affinity_span
+        if span > 0 and len(p) > span:
+            # hash exactly the first cacheable block span; prompts no
+            # longer than it can't share a FULL cached block, so routing
+            # them by affinity would buy nothing
+            phash = zlib.crc32(p[:span].tobytes())
+            with self._lock:
+                self._rid_phash[rid] = phash
+        self._work.put((rid, p, int(max_new_tokens)))
         return True
 
     @property
@@ -402,7 +430,11 @@ class FrontDoor:
             if self._attempts_used(rid) >= cfg.max_attempts:
                 self._fail(rid, "FT_RPC_RETRIES")
                 return
-            client = self._routable(exclude=avoid)
+            with self._lock:
+                phash = self._rid_phash.get(rid)
+                prefer = self._affinity.get(phash) if phash is not None \
+                    else None
+            client = self._routable(exclude=avoid, prefer=prefer)
             if client is None and avoid:
                 # everyone left has drain-refused us: better a draining
                 # replica (it may still be up) than nobody
@@ -546,6 +578,11 @@ class FrontDoor:
                              peer=client.rank)
                 return
             self.completed[rid] = result
+            phash = self._rid_phash.pop(rid, None)
+            if phash is not None:
+                # the winner's prefix index now holds this first block —
+                # send the next request sharing it back there
+                self._affinity[phash] = result.rank
         self.metrics.counter("serve.completed").inc()
         self.metrics.histogram("serve.ttft_ms").observe(ttft_s * 1e3)
         client.registry.histogram("serve.ttft_ms").observe(ttft_s * 1e3)
@@ -560,6 +597,7 @@ class FrontDoor:
             if rid in self.completed:
                 return
             self.failed[rid] = code
+            self._rid_phash.pop(rid, None)
         self.metrics.counter("serve.failed").inc()
         record_event("serve_failed", rid=rid, code=code)
 

@@ -73,6 +73,7 @@ __all__ = [
     "BlockAllocator",
     "init_pools",
     "write_prefill",
+    "write_prefill_at",
     "write_swapped",
     "paged_decode_step",
     "make_paged_decode_fn",
@@ -124,11 +125,24 @@ class PagedCacheConfig:
 
 
 class BlockAllocator:
-    """Host-side LIFO free list over block ids ``1..num_blocks-1``.
+    """Host-side LIFO free list over block ids ``1..num_blocks-1``, with
+    per-block reference counts for cross-request prefix sharing.
 
     LIFO keeps the working set of pool pages hot; double frees and
     foreign ids are loud errors (a silently double-freed block would be
-    handed to two sequences and corrupt both)."""
+    handed to two sequences and corrupt both).
+
+    Refcount semantics: ``alloc`` hands blocks out at refcount 1;
+    ``retain`` adds a holder (a second sequence sharing a cached prefix
+    block, or the prefix index adopting a retired prompt block);
+    ``release`` drops one holder and the free list regains the block only
+    when the count reaches 0.  ``free`` keeps its historical meaning —
+    "this block is exclusively mine and I am done" — and is LOUD when the
+    block is shared (freeing a shared block out from under its other
+    holders is exactly the corruption refcounts exist to prevent).
+    ``fork_block`` is the copy-on-write primitive: given a SHARED block,
+    it allocates a private twin for the caller to copy into; the caller
+    then releases its reference on the shared original."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -136,23 +150,66 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() yields 1 first
         self._allocated: set[int] = set()
+        self._refcount: dict[int, int] = {}
 
     @property
     def num_free(self) -> int:
         return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        """Current holder count (0 for free / never-allocated ids)."""
+        return self._refcount.get(block, 0)
+
     def alloc(self, n: int) -> list[int]:
         """Take ``n`` blocks or raise :class:`CacheExhausted` (taking
-        nothing — admission is all-or-nothing per request)."""
+        nothing — admission is all-or-nothing per request).  Each block
+        comes out at refcount 1."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             raise CacheExhausted(n, len(self._free))
         out = [self._free.pop() for _ in range(n)]
         self._allocated.update(out)
+        for b in out:
+            self._refcount[b] = 1
         return out
 
+    def retain(self, blocks) -> None:
+        """Add one holder to each block (all must be allocated)."""
+        blocks = list(blocks)
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"cannot retain block {b}: not allocated"
+                )
+        for b in blocks:
+            self._refcount[b] += 1
+
+    def release(self, blocks) -> None:
+        """Drop one holder from each block; a block returns to the free
+        list only when its refcount reaches 0.  Duplicate ids and
+        non-allocated blocks are loud — releasing the same block twice in
+        one call would silently drop a holder someone else still is."""
+        blocks = list(blocks)
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(f"duplicate block ids in release(): {blocks}")
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"block {b} is not allocated (double release or "
+                    f"foreign id)"
+                )
+        for b in blocks:
+            self._refcount[b] -= 1
+            if self._refcount[b] == 0:
+                del self._refcount[b]
+                self._allocated.remove(b)
+                self._free.append(b)
+
     def free(self, blocks) -> None:
+        """Return exclusively-held blocks to the free list.  Loud on
+        duplicates, foreign ids, AND shared blocks — a holder that thinks
+        it owns a shared block outright has a refcount bug upstream."""
         blocks = list(blocks)
         if len(set(blocks)) != len(blocks):
             raise ValueError(f"duplicate block ids in free(): {blocks}")
@@ -161,9 +218,31 @@ class BlockAllocator:
                 raise ValueError(
                     f"block {b} is not allocated (double free or foreign id)"
                 )
+            if self._refcount[b] != 1:
+                raise ValueError(
+                    f"block {b} is shared (refcount "
+                    f"{self._refcount[b]}); use release(), not free()"
+                )
         for b in blocks:
+            del self._refcount[b]
             self._allocated.remove(b)
             self._free.append(b)
+
+    def fork_block(self, src: int) -> int:
+        """Copy-on-write fork: allocate a private twin for SHARED block
+        ``src``.  The caller copies the pool contents (or re-derives them
+        bitwise, as the suffix prefill does) into the returned block and
+        then releases its own reference on ``src``.  Forking a private
+        block is a loud error — a refcount-1 block needs no COW, and a
+        caller asking for one has lost track of who shares what."""
+        if src not in self._allocated:
+            raise ValueError(f"cannot fork block {src}: not allocated")
+        if self._refcount[src] < 2:
+            raise ValueError(
+                f"cannot fork block {src}: refcount "
+                f"{self._refcount[src]} (not shared — write in place)"
+            )
+        return self.alloc(1)[0]
 
 
 def init_pools(cfg: TransformerConfig, pcfg: PagedCacheConfig) -> dict:
@@ -198,6 +277,40 @@ def write_prefill(pools: dict, cache: dict, block_ids) -> dict:
             )
         out_k.append(pk.at[idx].set(kc[0, : n * bs].reshape(n, bs, *pk.shape[2:])))
         out_v.append(pv.at[idx].set(vc[0, : n * bs].reshape(n, bs, *pv.shape[2:])))
+    return {"k": out_k, "v": out_v}
+
+
+def write_prefill_at(pools: dict, cache: dict, block_ids,
+                     start_block: int) -> dict:
+    """Scatter a prefill cache's positions FROM ``start_block * bs``
+    onward into ``block_ids`` — the suffix half of a prefix-cache hit.
+
+    ``cache`` is ``prefill_suffix``'s output for a batch of ONE: its
+    positions below ``start_block * bs`` belong to CACHED blocks this
+    call must never rewrite (they may be shared with other sequences), so
+    only the slice ``[start_block*bs, (start_block + len(block_ids))*bs)``
+    is scattered.  ``start_block`` must be static (it selects a slice at
+    trace time); the engine jits this with ``static_argnums``.
+    """
+    idx = jnp.asarray(block_ids, jnp.int32)
+    n = int(idx.shape[0])
+    if start_block < 0:
+        raise ValueError(f"start_block must be >= 0, got {start_block}")
+    out_k, out_v = [], []
+    for pk, pv, kc, vc in zip(pools["k"], pools["v"], cache["k"], cache["v"]):
+        bs = pk.shape[1]
+        s0 = start_block * bs
+        if kc.shape[1] < s0 + n * bs:
+            raise ValueError(
+                f"prefill cache holds {kc.shape[1]} positions, blocks "
+                f"{start_block}..{start_block + n} need {s0 + n * bs}"
+            )
+        out_k.append(
+            pk.at[idx].set(kc[0, s0 : s0 + n * bs].reshape(n, bs, *pk.shape[2:]))
+        )
+        out_v.append(
+            pv.at[idx].set(vc[0, s0 : s0 + n * bs].reshape(n, bs, *pv.shape[2:]))
+        )
     return {"k": out_k, "v": out_v}
 
 

@@ -443,6 +443,11 @@ def main(argv=None) -> int:
                     help="CSV of prompt lengths to compile before serving")
     ap.add_argument("--warmup-max-new", type=int, default=0,
                     help="warm the block-reservation write for prompt+this")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable the cross-request prefix cache")
+    ap.add_argument("--warmup-suffix-lens", default="",
+                    help="CSV of cached:suffix pairs (e.g. 32:4,32:12) to "
+                         "compile the suffix prefill for before serving")
     args = ap.parse_args(argv)
 
     import jax
@@ -465,10 +470,11 @@ def main(argv=None) -> int:
         blocks_per_seq=args.blocks_per_seq,
     )
     engine = ServingEngine(
-        params, cfg, pcfg, BatcherConfig(slots=args.slots),
+        params, cfg, pcfg,
+        BatcherConfig(slots=args.slots, prefix_cache=args.prefix_cache),
         fused=False,  # the gather path: proven bitwise vs generate
     )
-    if args.warmup_prompt_lens:
+    if args.warmup_prompt_lens or args.warmup_suffix_lens:
         lens = sorted(
             {int(t) for t in args.warmup_prompt_lens.split(",") if t}
         )
@@ -476,7 +482,11 @@ def main(argv=None) -> int:
             {pcfg.blocks_for(t + args.warmup_max_new) for t in lens}
             if args.warmup_max_new else ()
         )
-        engine.warmup(lens, blocks)
+        buckets = [
+            tuple(int(x) for x in pair.split(":"))
+            for pair in args.warmup_suffix_lens.split(",") if pair
+        ]
+        engine.warmup(lens, blocks, suffix_buckets=buckets)
 
     rcfg = ReplicaConfig(
         args.rank, args.dir, host=args.host, port=args.port,

@@ -163,15 +163,22 @@ class TestPallasReduce:
 
 
 class TestBenchPyContract:
+    @pytest.mark.slow
     def test_one_json_line(self):
         """bench.py must print exactly one JSON line with the driver's keys
-        (forced to the CPU path so it never touches the TPU tunnel)."""
+        (forced to the CPU path so it never touches the TPU tunnel).
+
+        Slow-marked: the tripwire sweep bench.py grew (quantize gloo A/B,
+        serving/paged/prefix smokes, chaos matrices, rpc kill chaos) takes
+        >10 minutes on a single core — it silently outlived the old 600 s
+        subprocess budget inside the "~1-minute core subset" and timed out
+        on every default run.  CI runs it as its own bench-contract job."""
         env = {"FLEXTREE_BENCH_PLATFORM": "cpu", "PATH": "/usr/bin:/bin"}
         p = subprocess.run(
             [sys.executable, "/root/repo/bench.py"],
             capture_output=True,
             text=True,
-            timeout=600,
+            timeout=1500,
             env=env,
         )
         assert p.returncode == 0, p.stderr[-500:]
