@@ -360,6 +360,9 @@ def run_static_analysis_tripwire(timeout_s: int = 120) -> dict:
     every IR-compiled collective matches its IR stage list
     (count/kind/group-width per stage); any divergence between the
     verified schedule object and the executable is a non-zero count.
+    ``control_plane_analysis_violations`` (ISSUE 18): the exhaustive
+    protocol model check (coordination/lease/RPC small worlds) plus the
+    concurrency lint's whole-tree sweep, combined.
 
     Runs the full CLI (``flextree_tpu.analysis``) in a subprocess: it
     pins its own 8-vdev CPU mesh (safe regardless of this process's
@@ -390,6 +393,13 @@ def run_static_analysis_tripwire(timeout_s: int = 120) -> dict:
             "ir_equivalence_violations": report["layers"]["ir_equivalence"][
                 "violations"
             ],
+            # ISSUE 18: the control-plane layers' combined verdict — the
+            # exhaustive protocol model check plus the concurrency/lock-
+            # discipline lint; same absent-is-not-clean contract
+            "control_plane_analysis_violations": (
+                report["layers"]["protocol_check"]["violations"]
+                + report["layers"]["concurrency_lint"]["violations"]
+            ),
         }
         if not report["mutation_selftest"]["all_caught"]:
             out["analysis_error"] = "mutation self-test escaped"

@@ -1,6 +1,6 @@
 """FlexTree static verifier: ahead-of-time analysis of generated programs.
 
-Three layers (plus the IR-equivalence pass), one report:
+Five layers (plus the IR-equivalence pass), one report:
 
 1. :mod:`.schedule_check` — model-check generated message programs for
    every schedule family (tree/ring/lonely/swing/generalized × chunked):
@@ -16,6 +16,16 @@ Three layers (plus the IR-equivalence pass), one report:
 3. :mod:`.jit_hygiene` — AST lint over the library source for
    wall-clock/RNG calls inside jitted code, Python branching on traced
    values, and missing ``static_argnames``.
+4. :mod:`.protocol_check` — explicit-state model checking of the
+   control-plane protocols: exhaustive small-world exploration of the
+   extracted coordination/lease/RPC transition models (each living
+   beside its implementation, pinned by shared constants +
+   ``tests/test_control_plane_analysis.py``) with faults injected at
+   every transition.
+5. :mod:`.concurrency_lint` — AST/call-graph lint of the threaded host
+   code: lock-order cycles, blocking calls under a lock, writes to
+   ``# guarded-by:``-annotated fields without the lock, and blocking
+   primitives reachable from signal handlers.
 
 The suite is self-distrusting: :mod:`.mutation` seeds known corruption
 classes and asserts each is caught — a checker that passes everything is

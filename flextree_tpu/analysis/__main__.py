@@ -4,9 +4,10 @@
 
 Exit status is the CI contract: 0 iff the clean tree reports zero
 violations AND every seeded corruption class is caught by its layer.
-``--skip-hlo`` runs only the JAX-less layers (schedule model checker +
-jit hygiene) for environments without a usable backend; the committed
-report is always produced by a full run.
+``--skip-hlo`` runs only the JAX-less layers (schedule model checker,
+jit hygiene, control-plane protocol checker, concurrency lint) for
+environments without a usable backend; the committed report is always
+produced by a full run.
 
 ``--programs SUBSTR [SUBSTR ...]`` filters the schedule / split-phase /
 IR-family / ir-equivalence matrices to rows whose name contains any of
@@ -48,8 +49,10 @@ def build_report(include_hlo: bool = True, programs=None) -> dict:
     from ..schedule.analysis import traffic_summary
     from ..schedule.stages import Topology
     from .base import violations_to_json
+    from .concurrency_lint import run_concurrency_lint
     from .jit_hygiene import run_jit_hygiene
     from .mutation import run_mutation_selftest
+    from .protocol_check import run_protocol_check
     from .schedule_check import (
         check_ir_families,
         check_split_schedules,
@@ -100,6 +103,30 @@ def build_report(include_hlo: bool = True, programs=None) -> dict:
     jit_v, jit_detail = run_jit_hygiene()
     violations += jit_v
     report["layers"]["jit_hygiene"] = {**jit_detail, "violations": len(jit_v)}
+
+    # layer 4: exhaustive small-world exploration of the control-plane
+    # protocol models (JAX-less — runs in --skip-hlo environments too)
+    proto_times: dict = {}
+    proto_v, proto_detail = run_protocol_check(
+        programs=programs, times=proto_times
+    )
+    violations += proto_v
+    report["layers"]["protocol_check"] = {
+        **proto_detail, "violations": len(proto_v),
+    }
+    times["protocol_check"] = proto_times
+
+    # layer 5: concurrency / lock-discipline lint over the threaded
+    # host code (also JAX-less)
+    conc_times: dict = {}
+    conc_v, conc_detail = run_concurrency_lint(
+        programs=programs, times=conc_times
+    )
+    violations += conc_v
+    report["layers"]["concurrency_lint"] = {
+        **conc_detail, "violations": len(conc_v),
+    }
+    times["concurrency_lint"] = conc_times
 
     report["mutation_selftest"] = run_mutation_selftest(include_hlo=include_hlo)
     report["violations"] = violations_to_json(violations)

@@ -20,13 +20,18 @@ __all__ = ["Violation", "violations_to_json"]
 class Violation:
     """One analyzer finding.
 
-    ``layer``: ``"schedule"`` | ``"hlo"`` | ``"jit"``.
+    ``layer``: ``"schedule"`` | ``"hlo"`` | ``"jit"`` | ``"protocol"`` |
+    ``"concurrency"``.
     ``kind``: a stable machine-readable class (``"deadlock"``,
     ``"double-count"``, ``"dropped-block"``, ``"asymmetric-match"``,
     ``"chunk-overlap"``, ``"unbounded-wait"``, ``"budget"``,
     ``"dtype-drift"``, ``"host-transfer"``, ``"donation"``,
     ``"wall-clock"``, ``"rng"``, ``"traced-branch"``,
-    ``"static-argnames"``) — the mutation self-test asserts on these.
+    ``"static-argnames"``; protocol kinds like ``"epoch-double-commit"``,
+    ``"double-grant"``, ``"completed-rid-reexecuted"``,
+    ``"clean-rank-fenced"``; concurrency kinds ``"lock-order"``,
+    ``"lock-blocking"``, ``"guard"``, ``"signal-blocking"``) — the
+    mutation self-test asserts on these.
     ``where``: entrypoint / schedule / file the finding is in.
     ``stage``/``src``/``dst``/``block``: schedule coordinates (None for the
     other layers; ``src``/``dst`` double as line numbers for jit findings).

@@ -32,6 +32,23 @@ host-rng              3       ``rng``
 traced-branch         3       ``traced-branch``
 missing-static        3       ``static-argnames``
 ====================  ======  ==========================================
+
+Extended by ISSUE 18 with the control-plane classes — layer 4 seeds a
+semantic corruption into a protocol transition *model* (the checker must
+prove the resulting violation REACHABLE, witness trace included), layer
+5 seeds a discipline corruption into source text:
+
+======================  ======  ========================================
+commit-without-all-acks 4       ``commit-quorum`` (commit before quorum)
+double-grant            4       ``double-grant`` (publish skips the
+                                one-holder-per-chip validation)
+replay-miss             4       ``completed-rid-reexecuted`` (idempotency
+                                store misses on replay)
+lock-order-inversion    5       ``lock-order`` (ABBA cycle)
+dropped-guard           5       ``guard`` (guarded field written bare)
+signal-path-blocking    5       ``signal-blocking`` (handler reaches a
+                                blocking lock acquire)
+======================  ======  ========================================
 """
 
 from __future__ import annotations
@@ -260,6 +277,112 @@ def _mutate_hygiene(kind):
     return run
 
 
+# ----------------------------------------------------- layer 4 mutations
+#
+# Each seeds one semantic corruption into a protocol transition model and
+# runs the exhaustive explorer over it: "caught" means the expected
+# violation kind is REACHABLE (the checker carries a witness trace), not
+# merely that some assertion somewhere tripped.
+
+
+def _mutate_commit_without_all_acks():
+    from ..runtime.coord_model import CoordModel
+    from .protocol_check import run_protocol_check
+
+    vs, _ = run_protocol_check(
+        models=[CoordModel(3, mutation="commit_without_all_acks")]
+    )
+    return vs
+
+
+def _mutate_double_grant():
+    from ..runtime.lease_model import LeaseModel
+    from .protocol_check import run_protocol_check
+
+    vs, _ = run_protocol_check(models=[LeaseModel(mutation="double_grant")])
+    return vs
+
+
+def _mutate_replay_miss():
+    from ..serving.rpc_model import RpcModel
+    from .protocol_check import run_protocol_check
+
+    vs, _ = run_protocol_check(models=[RpcModel(mutation="replay_miss")])
+    return vs
+
+
+# ----------------------------------------------------- layer 5 mutations
+
+_LOCK_ORDER_MUTANT = '''
+import threading
+
+
+class Broker:
+    def __init__(self):
+        self._xlock = threading.Lock()
+        self._ylock = threading.Lock()
+
+    def forward(self):
+        with self._xlock:
+            with self._ylock:
+                pass
+
+    def backward(self):
+        with self._ylock:
+            with self._xlock:
+                pass
+'''
+
+_DROPPED_GUARD_MUTANT = '''
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self.counts = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self.bump("beat")
+
+    def bump(self, key):
+        self.counts[key] = self.counts.get(key, 0) + 1
+'''
+
+_SIGNAL_BLOCKING_MUTANT = '''
+import signal
+import threading
+
+
+class Dumper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []
+
+    def dump(self):
+        with self._lock:
+            return list(self._ring)
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self.dump()
+'''
+
+
+def _mutate_concurrency(src):
+    def run():
+        from .concurrency_lint import scan_source
+
+        vs, _ = scan_source(src, "mutated_source.py")
+        return vs
+
+    return run
+
+
 # ------------------------------------------------------------- harness
 
 #: name -> (expected_kind, expected_layer, thunk)
@@ -284,6 +407,23 @@ MUTATIONS = {
     "host-rng": ("rng", "jit", _mutate_hygiene("rng")),
     "traced-branch": ("traced-branch", "jit", _mutate_hygiene("traced-branch")),
     "missing-static": ("static-argnames", "jit", _mutate_hygiene("static-argnames")),
+    "commit-without-all-acks": (
+        "commit-quorum", "protocol", _mutate_commit_without_all_acks,
+    ),
+    "double-grant": ("double-grant", "protocol", _mutate_double_grant),
+    "replay-miss": (
+        "completed-rid-reexecuted", "protocol", _mutate_replay_miss,
+    ),
+    "lock-order-inversion": (
+        "lock-order", "concurrency", _mutate_concurrency(_LOCK_ORDER_MUTANT),
+    ),
+    "dropped-guard": (
+        "guard", "concurrency", _mutate_concurrency(_DROPPED_GUARD_MUTANT),
+    ),
+    "signal-path-blocking": (
+        "signal-blocking", "concurrency",
+        _mutate_concurrency(_SIGNAL_BLOCKING_MUTANT),
+    ),
 }
 
 

@@ -100,15 +100,16 @@ class FlightRecorder:
         self.capacity = int(capacity)
         self.spill_every = max(1, int(spill_every))
         self.source = source
-        self.events: deque = deque(maxlen=capacity)
-        self.recorded = 0
-        self.dumps = 0
-        self.spill_errors = 0  # batches dropped on write/flush failure
+        self.events: deque = deque(maxlen=capacity)  # guarded-by: _lock
+        self.recorded = 0  # guarded-by: _lock
+        self.dumps = 0  # guarded-by: _lock
+        # batches dropped on write/flush failure
+        self.spill_errors = 0  # guarded-by: _lock
         self._seq = itertools.count()
-        self._pending: list[str] = []
+        self._pending: list[str] = []  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._fh = None
-        self._closed = False
+        self._fh = None  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         if self.dir is not None:
             os.makedirs(self.dir, exist_ok=True)
             self._fh = open(  # noqa: SIM115 — held for the recorder's life

@@ -171,6 +171,14 @@ class ControlDecision:
         return decision_fingerprint(self.kind, self.payload)
 
 
+# The closed set of decision kinds the control plane carries.  Shared
+# with the extracted transition model (`runtime/coord_model.py`) so the
+# protocol checker and the implementation cannot silently diverge on
+# what a decision IS; `tests/test_control_plane_analysis.py` pins both
+# sides to this tuple.
+DECISION_KINDS = ("replan", "shrink", "resize")
+
+
 def decision_fingerprint(kind: str, payload: dict) -> str:
     """Stable content hash of a decision — the quantity the chaos floors
     compare across survivors ("same plan fingerprint") and the idempotency

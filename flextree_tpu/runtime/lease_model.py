@@ -1,0 +1,270 @@
+"""Extracted transition model of the lease ledger's revoke→ack→grant.
+
+The two-phase chip handoff of :mod:`.leases` + :mod:`..arbiter.core`
+reduced to an explicit-state machine for `analysis/protocol_check.py`:
+an arbiter revokes chips from training (parking them on the
+:data:`~.leases.ARBITER` holder), waits for the holder's ack, then
+grants the parked chips to serving — with a tenant restart injectable
+mid-handoff at every transition.
+
+Pinned to the implementation:
+
+- the three holders ARE :data:`~.leases.TRAIN` / :data:`~.leases.SERVE`
+  / :data:`~.leases.ARBITER` (imported, not restated);
+- the publish-time rules mirror ``LeaseLedger.publish``: epochs
+  strictly increase, a chip in two holders is refused at the write —
+  the ``"double_grant"`` mutation skips exactly that validation;
+- the grant gate mirrors ``ElasticArbiter._maybe_complete_handoff``:
+  ONE ack read serves both the epoch and the control stamp.  The
+  ``"torn_ack_read"`` mutation re-introduces the two-reads version PR
+  14's review fixed (``read_ack`` returning the whole doc): the epoch
+  is read from the newest ack version and the control stamp from the
+  previous one, and the checker flags any consumed pair that no single
+  ack version ever contained;
+- ``tests/test_control_plane_analysis.py`` drives the REAL
+  ``LeaseLedger`` through model-derived traces (double-grant refused at
+  the write, epoch floor enforced) to pin the shared rules.
+
+Honest limits: control files are atomic state (CRC tears are proven at
+the ctrlfile layer), the SLO reading that *triggers* a preempt is
+abstracted into a budget (the protocol is what's being checked, not the
+policy), and serving replica release on return is the synchronous
+``on_serve_return`` callback, modelled as part of the return
+transition.
+
+Mutations: ``"double_grant"`` (publish skips the one-holder-per-chip
+validation), ``"grant_before_ack"`` (phase 2 fires without training's
+ack — the revoked chips reach serving while training still runs on
+them), ``"torn_ack_read"`` (see above).
+"""
+
+from __future__ import annotations
+
+from .leases import ARBITER, SERVE, TRAIN
+
+__all__ = ["LeaseModel", "LEASE_MUTATIONS"]
+
+LEASE_MUTATIONS = ("double_grant", "grant_before_ack", "torn_ack_read")
+
+_CHIPS = ("c0", "c1")
+
+
+class LeaseModel:
+    """State = (epoch, grants, tenants, pending, acks, budgets).
+
+    ``grants``: per-holder chip frozensets (the ledger document).
+    ``tenants``: ``(in_use, seen_epoch)`` for TRAIN and SERVE — what
+    each tenant actually runs on vs what it has observed.  ``pending``:
+    in-flight handoff ``(chips, revoke_epoch)`` or None.  ``acks``:
+    TRAIN's ack-file version history (newest last, bounded) of
+    ``(epoch, control_stamp)`` pairs — history, because the torn-read
+    class is precisely about pairing fields across versions.
+    ``budgets``: ``(preempts, returns, restarts)`` remaining.
+    """
+
+    name_prefix = "lease"
+
+    def __init__(self, *, preempts: int = 2, returns: int = 1,
+                 restarts: int = 1, mutation: str | None = None):
+        if mutation is not None and mutation not in LEASE_MUTATIONS:
+            raise ValueError(f"unknown lease mutation: {mutation}")
+        self.mutation = mutation
+        self.budget0 = (preempts, returns, restarts)
+        self.name = f"{self.name_prefix}@{len(_CHIPS)}chips"
+        if mutation:
+            self.name += f"+{mutation}"
+
+    def initial(self):
+        grants = ((TRAIN, frozenset(_CHIPS)), (SERVE, frozenset()),
+                  (ARBITER, frozenset()))
+        tenants = ((frozenset(_CHIPS), 0), (frozenset(), 0))  # train, serve
+        return (0, grants, tenants, None, ((0, 0),), self.budget0)
+
+    def is_fault_label(self, label: str) -> bool:
+        return label.startswith("restart")
+
+    # ---- transitions -------------------------------------------------------
+
+    def transitions(self, state):
+        epoch, grants, tenants, pending, acks, budgets = state
+        preempts, returns, restarts = budgets
+        g = dict(grants)
+        (t_use, t_seen), (s_use, s_seen) = tenants
+        out = []
+
+        # -- phase 1: revoke (preempt) — park a nonempty subset of
+        #    training's chips on the arbiter holder
+        if pending is None and preempts > 0 and g[TRAIN]:
+            for chips in _subsets(g[TRAIN]):
+                ng = dict(g)
+                ng[TRAIN] = g[TRAIN] - chips
+                ng[ARBITER] = g[ARBITER] | chips
+                t = self._publish(state, epoch + 1, ng,
+                                  label=f"revoke({sorted(chips)},e{epoch+1})",
+                                  pending=(chips, epoch + 1),
+                                  budgets=(preempts - 1, returns, restarts))
+                out.append(t)
+
+        # -- tenants observe a newer ledger: adopt the granted set (stop
+        #    using revoked chips) — TrainLeaseClient.poll's adopt step
+        if t_seen < epoch:
+            nt = ((g[TRAIN], epoch), (s_use, s_seen))
+            out.append((f"observe(train,e{epoch})",
+                        (epoch, grants, nt, pending, acks, budgets), []))
+        if s_seen < epoch:
+            nt = ((t_use, t_seen), (g[SERVE], epoch))
+            out.append((f"observe(serve,e{epoch})",
+                        (epoch, grants, nt, pending, acks, budgets), []))
+
+        # -- training acks what it observed (the ack file carries the
+        #    lease epoch + the control stamp of the group decision it
+        #    applied the revocation under — ONE document)
+        if t_seen > acks[-1][0]:
+            stamp = t_seen  # the control stamp advances with each applied
+            # revocation epoch; modelling it as the seen epoch keeps the
+            # two fields distinct across versions without a second counter
+            nacks = (acks + ((t_seen, stamp),))[-3:]
+            out.append((f"ack(train,e{t_seen})",
+                        (epoch, grants, tenants, pending, nacks, budgets),
+                        []))
+
+        # -- phase 2: grant — the arbiter hands parked chips to serving
+        #    once training's ack covers the revoke epoch
+        if pending is not None and g[ARBITER] >= pending[0]:
+            chips, revoke_epoch = pending
+            viol = []
+            if self.mutation == "torn_ack_read" and len(acks) >= 2:
+                # the seeded two-reads bug: epoch from the newest ack
+                # version, control stamp from the previous one
+                consumed = (acks[-1][0], acks[-2][1])
+                if consumed not in acks:
+                    viol.append((
+                        "torn-ack-read",
+                        f"arbiter consumed ack pair {consumed} that no "
+                        f"single ack version ever contained ({list(acks)}) "
+                        "— epoch and control stamp read from different "
+                        "versions",
+                    ))
+                acked = consumed[0]
+            else:
+                acked = acks[-1][0]
+            if acked >= revoke_epoch or self.mutation == "grant_before_ack":
+                ng = dict(g)
+                ng[ARBITER] = g[ARBITER] - chips
+                ng[SERVE] = g[SERVE] | chips
+                t = self._publish(
+                    state, epoch + 1, ng,
+                    label=f"grant({sorted(chips)},e{epoch+1})",
+                    pending=None, budgets=budgets, extra_viol=viol)
+                out.append(t)
+
+        # -- return: the burst drained — serving releases synchronously
+        #    (on_serve_return) and the chips go back to training
+        if pending is None and returns > 0 and g[SERVE]:
+            chips = g[SERVE]
+            ng = dict(g)
+            ng[SERVE] = frozenset()
+            ng[TRAIN] = g[TRAIN] | chips
+            nt = ((t_use, t_seen), (s_use - chips, s_seen))
+            t = self._publish(
+                state, epoch + 1, ng,
+                label=f"return({sorted(chips)},e{epoch+1})",
+                pending=None, budgets=(preempts, returns - 1, restarts),
+                tenants=nt)
+            out.append(t)
+
+        # -- fault injection: tenant restart at every transition — the
+        #    restarted tenant re-reads the ledger (first observation
+        #    adopts) and its ack files survive on disk
+        if restarts > 0:
+            nb = (preempts, returns, restarts - 1)
+            nt = ((g[TRAIN], epoch), (s_use, s_seen))
+            out.append((f"restart(train)",
+                        (epoch, grants, nt, pending, acks, nb), []))
+            nt = ((t_use, t_seen), (g[SERVE], epoch))
+            out.append((f"restart(serve)",
+                        (epoch, grants, nt, pending, acks, nb), []))
+        return out
+
+    def _publish(self, state, new_epoch, new_grants, *, label, pending,
+                 budgets, tenants=None, extra_viol=None):
+        """``LeaseLedger.publish``'s write-time rules: strictly
+        increasing epoch, every chip in exactly one holder — skipped by
+        the ``double_grant`` mutation, which is what makes the
+        invariant's violation reachable."""
+        epoch, grants, old_tenants, _, acks, _ = state
+        viol = list(extra_viol or [])
+        if new_epoch <= epoch:
+            viol.append((
+                "epoch-regression",
+                f"lease epoch {new_epoch} published after {epoch}",
+            ))
+        if self.mutation == "double_grant" and pending is None and \
+                new_grants[SERVE]:
+            # the seeded corruption: the grant ALSO leaves the chips in
+            # the training set (validation skipped)
+            new_grants = dict(new_grants)
+            new_grants[TRAIN] = new_grants[TRAIN] | new_grants[SERVE]
+        seen: dict = {}
+        for holder in (TRAIN, SERVE, ARBITER):
+            for chip in new_grants[holder]:
+                if chip in seen:
+                    viol.append((
+                        "double-grant",
+                        f"chip {chip} granted to both {seen[chip]} and "
+                        f"{holder} at epoch {new_epoch}",
+                    ))
+                seen[chip] = holder
+        for chip in _CHIPS:
+            if chip not in seen:
+                viol.append((
+                    "lost-chip",
+                    f"chip {chip} granted to nobody at epoch {new_epoch}",
+                ))
+        ng = tuple((h, frozenset(new_grants[h]))
+                   for h in (TRAIN, SERVE, ARBITER))
+        return (label,
+                (new_epoch, ng, tenants or old_tenants, pending, acks,
+                 budgets),
+                viol)
+
+    # ---- reachable-state invariants ---------------------------------------
+
+    def state_violations(self, state):
+        """Checked at EVERY reachable state (not just writes): the
+        effective-exclusion invariant — no chip in active use by two
+        tenants — which the ack-before-grant handshake exists to hold."""
+        epoch, grants, tenants, pending, acks, budgets = state
+        (t_use, _), (s_use, _) = tenants
+        both = t_use & s_use
+        if both:
+            return [(
+                "dual-holder-use",
+                f"chips {sorted(both)} in active use by train AND serve "
+                f"at lease epoch {epoch} — the grant outran the "
+                "revocation ack",
+            )]
+        return []
+
+    def quiescent_violations(self, state):
+        epoch, grants, tenants, pending, acks, budgets = state
+        viols, truncated = [], False
+        if pending is not None:
+            # a handoff whose grant is enabled would not be quiescent;
+            # pending at quiescence means the ack gate can never open
+            viols.append((
+                "wedged-handoff",
+                f"handoff of {sorted(pending[0])} (revoke epoch "
+                f"{pending[1]}) never completed",
+            ))
+        return viols, truncated
+
+
+def _subsets(chips):
+    chips = sorted(chips)
+    out = []
+    for mask in range(1, 1 << len(chips)):
+        out.append(frozenset(
+            c for i, c in enumerate(chips) if mask & (1 << i)
+        ))
+    return out

@@ -105,7 +105,7 @@ class BackgroundSaver:
         self.saves = 0
         self.dropped = 0  # submits coalesced away by latest-wins
         self.errors: list[str] = []
-        self._pending = None
+        self._pending = None  # guarded-by: _lock (depth-1 latest-wins slot)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._idle = threading.Event()

@@ -133,13 +133,13 @@ class ReplicaClient:
     def __init__(self, rank: int, cfg: FrontDoorConfig):
         self.rank = rank
         self.cfg = cfg
-        self.host: str | None = None
-        self.port: int | None = None
-        self.pid: int | None = None
-        self.conn: RpcConnection | None = None
-        self.outstanding = 0
-        self.strikes = 0
-        self.open_until = 0.0  # breaker-open horizon on the _now clock
+        self.host: str | None = None  # guarded-by: _lock
+        self.port: int | None = None  # guarded-by: _lock
+        self.pid: int | None = None  # guarded-by: _lock
+        self.conn: RpcConnection | None = None  # guarded-by: _lock
+        self.outstanding = 0  # guarded-by: _lock
+        self.strikes = 0  # guarded-by: _lock
+        self.open_until = 0.0  # guarded-by: _lock (breaker horizon, _now)
         self.registry = MetricsRegistry()
         self.registry.windowed_histogram(
             "serve.ttft_ms", interval_s=cfg.slo_window_s / 10.0, intervals=10
@@ -147,24 +147,53 @@ class ReplicaClient:
         self._lock = threading.Lock()
 
     def update_endpoint(self, host: str, port: int, pid: int) -> None:
-        if (host, port, pid) != (self.host, self.port, self.pid):
+        # called from whichever dispatcher thread refreshes first, racing
+        # connection() on other dispatchers — same lock, or a half-updated
+        # endpoint can be dialed
+        with self._lock:
+            if (host, port, pid) == (self.host, self.port, self.pid):
+                return
             # a replaced process (same rank, new pid/port): drop the old
             # connection, the next attempt dials the new endpoint
-            if self.conn is not None:
-                self.conn.close()
-            self.conn = None
+            old, self.conn = self.conn, None
             self.host, self.port, self.pid = host, port, pid
+        if old is not None:
+            old.close()
 
     def connection(self) -> RpcConnection:
+        """The rank's live connection, dialing if needed.  The dial
+        happens OUTSIDE the lock — a slow/unreachable endpoint must cost
+        only the dialing thread, not every thread touching this client's
+        breaker or outstanding count for connect_timeout_s."""
         with self._lock:
             if self.conn is not None and self.conn.dead is None:
                 return self.conn
-            if self.host is None or self.port is None:
-                raise RpcConnRefused(f"rank {self.rank}: no endpoint")
-            self.conn = RpcConnection.connect(
-                self.host, self.port, timeout_s=self.cfg.connect_timeout_s
+            host, port = self.host, self.port
+        if host is None or port is None:
+            raise RpcConnRefused(f"rank {self.rank}: no endpoint")
+        conn = RpcConnection.connect(
+            host, port, timeout_s=self.cfg.connect_timeout_s
+        )
+        with self._lock:
+            if self.conn is not None and self.conn.dead is None:
+                # lost a dial race: keep the winner, close ours
+                loser = conn
+            elif (host, port) != (self.host, self.port):
+                # endpoint replaced mid-dial: the process we reached is
+                # the stale one — fail this attempt, next one redials
+                loser = conn
+                conn = None
+            else:
+                self.conn = conn
+                loser = None
+            winner = self.conn
+        if loser is not None:
+            loser.close()
+        if conn is None:
+            raise RpcConnRefused(
+                f"rank {self.rank}: endpoint replaced mid-dial"
             )
-            return self.conn
+        return winner
 
     # breaker ----------------------------------------------------------------
 
@@ -172,10 +201,15 @@ class ReplicaClient:
         return now < self.open_until
 
     def strike(self, now: float, registry: MetricsRegistry) -> None:
-        self.strikes += 1
-        if self.strikes >= self.cfg.breaker_strikes:
-            self.open_until = now + self.cfg.breaker_cooldown_s
-            self.strikes = 0
+        # dispatcher threads strike concurrently; unlocked, two strikes
+        # can lose an increment and a breaker that should open stays shut
+        with self._lock:
+            self.strikes += 1
+            opened = self.strikes >= self.cfg.breaker_strikes
+            if opened:
+                self.open_until = now + self.cfg.breaker_cooldown_s
+                self.strikes = 0
+        if opened:
             registry.counter("serve.breaker_opens").inc()
             record_event(
                 "breaker_open", peer=self.rank,
@@ -183,12 +217,14 @@ class ReplicaClient:
             )
 
     def clear_strikes(self) -> None:
-        self.strikes = 0
+        with self._lock:
+            self.strikes = 0
 
     def close(self) -> None:
-        if self.conn is not None:
-            self.conn.close()
-            self.conn = None
+        with self._lock:
+            conn, self.conn = self.conn, None
+        if conn is not None:
+            conn.close()
 
 
 class FrontDoor:
@@ -221,17 +257,17 @@ class FrontDoor:
         self.membership = MembershipView(
             dir, straggler_s=self.cfg.straggler_s, lease_s=self.cfg.lease_s
         )
-        self.clients: dict[int, ReplicaClient] = {}
-        self.completed: dict[int, FrontDoorResult] = {}
-        self.failed: dict[int, str] = {}  # rid -> FT_RPC_* code
-        self.shed_rids: list[int] = []  # intake refusals, accounted
-        self._arrival: dict[int, float] = {}  # rid -> intake stamp (once)
-        self._attempt_seq: dict[int, int] = {}
+        self.clients: dict[int, ReplicaClient] = {}  # guarded-by: _lock
+        self.completed: dict[int, FrontDoorResult] = {}  # guarded-by: _lock
+        self.failed: dict[int, str] = {}  # guarded-by: _lock (FT_RPC_* code)
+        self.shed_rids: list[int] = []  # guarded-by: _lock
+        self._arrival: dict[int, float] = {}  # guarded-by: _lock
+        self._attempt_seq: dict[int, int] = {}  # guarded-by: _lock
         # prefix affinity: first-block hash -> rank that last completed a
         # request carrying it (that replica's prefix index is warm)
-        self._affinity: dict[int, int] = {}
-        self._rid_phash: dict[int, int] = {}
-        self._inflight: set[int] = set()
+        self._affinity: dict[int, int] = {}  # guarded-by: _lock
+        self._rid_phash: dict[int, int] = {}  # guarded-by: _lock
+        self._inflight: set[int] = set()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._work: queue.Queue = queue.Queue()
         self._stop = threading.Event()
@@ -255,7 +291,11 @@ class FrontDoor:
             self._work.put(None)
         for t in self._threads:
             t.join(timeout=2.0)
-        for client in self.clients.values():
+        with self._lock:
+            clients = list(self.clients.values())  # a join timeout above
+            # can leave a dispatcher alive and refreshing; don't iterate
+            # the live dict under it
+        for client in clients:
             client.close()
 
     # ---- discovery ---------------------------------------------------------
@@ -278,9 +318,15 @@ class FrontDoor:
                 host, port, pid = ep["host"], int(ep["port"]), int(ep["pid"])
             except (KeyError, ValueError, TypeError):
                 continue
-            client = self.clients.get(rank)
-            if client is None:
-                client = self.clients[rank] = ReplicaClient(rank, self.cfg)
+            # the insert races other dispatchers' refresh() calls AND
+            # _routable's iteration — both under the same lock; the
+            # endpoint update itself locks per client, outside ours
+            with self._lock:
+                client = self.clients.get(rank)
+                if client is None:
+                    client = self.clients[rank] = ReplicaClient(
+                        rank, self.cfg
+                    )
             client.update_endpoint(host, port, pid)
 
     def _routable(self, exclude=(), prefer=None) -> "ReplicaClient | None":
@@ -292,8 +338,10 @@ class FrontDoor:
         self.refresh()
         states = {r: s.state for r, s in self.membership.poll().items()}
         now = _now()
+        with self._lock:
+            clients = list(self.clients.items())  # snapshot vs refresh()
         tiers: dict[str, list[ReplicaClient]] = {"healthy": [], "other": []}
-        for rank, client in self.clients.items():
+        for rank, client in clients:
             if rank in exclude or client.breaker_open(now):
                 continue
             state = states.get(rank)
@@ -397,8 +445,12 @@ class FrontDoor:
     ) -> None:
         """Fire one RPC on its own thread; the outcome (ok / typed error)
         lands on ``resq``.  Outstanding accounting is per replica and
-        released whatever happens."""
-        client.outstanding += 1
+        released whatever happens — under the client's lock, because
+        concurrent attempt threads' unlocked `+=`/`-=` lose updates and
+        a client that looks forever-busy (or forever-idle) skews the
+        least-outstanding routing for the rest of the run."""
+        with client._lock:
+            client.outstanding += 1
 
         def _run():
             send_mono = _now()
@@ -410,7 +462,8 @@ class FrontDoor:
             else:
                 resq.put(("ok", reply, client, send_mono))
             finally:
-                client.outstanding -= 1
+                with client._lock:
+                    client.outstanding -= 1
 
         threading.Thread(
             target=_run, daemon=True, name="ft-frontdoor-attempt"
@@ -608,7 +661,9 @@ class FrontDoor:
         one per replica (front-door-observed TTFT — queue and retries
         included, the SLO the client actually experiences)."""
         out = {"frontdoor": self.metrics.snapshot()}
-        for rank, client in sorted(self.clients.items()):
+        with self._lock:
+            clients = sorted(self.clients.items())
+        for rank, client in clients:
             out[f"fd_{rank:05d}"] = client.registry.snapshot()
         return out
 

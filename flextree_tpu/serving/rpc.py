@@ -237,9 +237,10 @@ class RpcConnection:
         self._sock = sock
         self._wlock = threading.Lock()
         self._lock = threading.Lock()
-        self._waiters: dict[int, dict] = {}  # corr -> {event, reply|error}
-        self._next_corr = 0
-        self._dead: RpcError | None = None
+        # corr -> {event, reply|error}
+        self._waiters: dict[int, dict] = {}  # guarded-by: _lock
+        self._next_corr = 0  # guarded-by: _lock
+        self._dead: RpcError | None = None  # guarded-by: _lock
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True, name="ft-rpc-reader"
         )
@@ -302,8 +303,12 @@ class RpcConnection:
             self._waiters[corr] = waiter
         framed = dict(payload, corr=corr)
         try:
+            # the blocking send under _wlock is the design: the write
+            # lock IS the frame serializer (partial frames from two
+            # callers must never interleave), it is held for exactly one
+            # sendall, and no other lock ever nests inside it
             with self._wlock:
-                send_frame(self._sock, framed)
+                send_frame(self._sock, framed)  # concurrency: ok — see above
         except RpcError as e:
             with self._lock:
                 self._waiters.pop(corr, None)
