@@ -1149,6 +1149,53 @@ def run_rpc_chaos_tripwire(timeout_s: int = 600) -> dict:
             pass
 
 
+def run_serve_elastic_tripwire(timeout_s: int = 900) -> dict:
+    """Supplementary key ``serving_tenancy_violations`` — the serving
+    fleet as a lease-ledger tenant, exercised end-to-end on this exact
+    tree (ISSUE 19; 0 = a restarted arbiter resumes its parked handoff,
+    a drain ack with requests still in flight is refused as a
+    ``ProtocolViolation``, and a SIGKILL'd predecessor's successor
+    cold-starts loudly with every in-flight rid delivered exactly once).
+
+    Runs ``tools/serve_elastic_chaos.py --smoke`` in a subprocess (the
+    full matrix with the autoscale spike and the handoff/shed A/Bs
+    lives in the committed SERVE_ELASTIC.json); a driver that fails to
+    run reports ``serving_tenancy_error`` with the key absent — absent
+    reads as "not verified", never as "clean".
+    """
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "serve_elastic_chaos.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        violations = sum(
+            0 if s.get("ok") else 1 for s in doc["scenarios"].values()
+        )
+        out = {"serving_tenancy_violations": violations}
+        if p.returncode != 0 and not violations:
+            out["serving_tenancy_error"] = (
+                f"serve_elastic_chaos rc={p.returncode}"
+            )
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"serving_tenancy_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -1227,6 +1274,7 @@ def main() -> int:
         result.update(run_arbiter_tripwire())
         result.update(run_coordination_tripwire())
         result.update(run_rpc_chaos_tripwire())
+        result.update(run_serve_elastic_tripwire())
         result.update(collect_prefix_tripwire(prefix_handle))
     print(json.dumps(result))
     return 0

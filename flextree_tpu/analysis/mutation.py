@@ -42,6 +42,10 @@ prove the resulting violation REACHABLE, witness trace included), layer
 commit-without-all-acks 4       ``commit-quorum`` (commit before quorum)
 double-grant            4       ``double-grant`` (publish skips the
                                 one-holder-per-chip validation)
+serve-ack-before-drain  4       ``dual-holder-use`` (serving acks a
+                                revocation with requests still in
+                                flight — the grant hands training chips
+                                serving is actively using)
 replay-miss             4       ``completed-rid-reexecuted`` (idempotency
                                 store misses on replay)
 lock-order-inversion    5       ``lock-order`` (ABBA cycle)
@@ -303,6 +307,16 @@ def _mutate_double_grant():
     return vs
 
 
+def _mutate_serve_ack_before_drain():
+    from ..runtime.lease_model import LeaseModel
+    from .protocol_check import run_protocol_check
+
+    vs, _ = run_protocol_check(
+        models=[LeaseModel(mutation="serve_ack_before_drain")]
+    )
+    return vs
+
+
 def _mutate_replay_miss():
     from ..serving.rpc_model import RpcModel
     from .protocol_check import run_protocol_check
@@ -411,6 +425,9 @@ MUTATIONS = {
         "commit-quorum", "protocol", _mutate_commit_without_all_acks,
     ),
     "double-grant": ("double-grant", "protocol", _mutate_double_grant),
+    "serve-ack-before-drain": (
+        "dual-holder-use", "protocol", _mutate_serve_ack_before_drain,
+    ),
     "replay-miss": (
         "completed-rid-reexecuted", "protocol", _mutate_replay_miss,
     ),

@@ -19,7 +19,13 @@ The cross-process protocol lives in :mod:`flextree_tpu.runtime.leases`
 is ``tools/arbiter_spike.py`` → ``ARBITER_SPIKE.json``.
 """
 
-from .core import ArbiterConfig, PoolArbiter, SloReading, pool_slo_reader
+from .core import (
+    ArbiterConfig,
+    PoolArbiter,
+    SloReading,
+    file_slo_reader,
+    pool_slo_reader,
+)
 from .inventory import DeviceInventory
 
 __all__ = [
@@ -28,4 +34,5 @@ __all__ = [
     "PoolArbiter",
     "SloReading",
     "pool_slo_reader",
+    "file_slo_reader",
 ]

@@ -41,12 +41,16 @@ the train/serve spans it caused.
 from __future__ import annotations
 
 import dataclasses
+import glob
+import json
 import math
+import os
 import time
 from collections import deque
 
 from ..obs import record_event
-from ..obs.metrics import merged_window_percentile
+from ..obs.metrics import load_window, merged_window_percentile
+from ..runtime.ctrlfile import read_control_json, write_control_json
 from ..runtime.leases import ARBITER, SERVE, TRAIN, LeaseLedger
 from ..utils.logging import get_logger
 from .inventory import DeviceInventory
@@ -55,10 +59,18 @@ __all__ = [
     "ArbiterConfig",
     "SloReading",
     "PoolArbiter",
+    "STATE_FILE",
+    "file_slo_reader",
     "pool_slo_reader",
 ]
 
 log = get_logger("flextree.arbiter")
+
+#: the arbiter's own durable state beside the ledger: which chips are on
+#: loan and which handoff is mid-flight — what a restarted arbiter needs
+#: beyond the ledger (the ledger says WHERE chips are, not where a parked
+#: set was HEADED)
+STATE_FILE = "arbiter_state.json"
 
 # injection point for tests (patch this, not time.time): cooldowns and
 # ledger stamps are wall time, the heartbeat-dir convention
@@ -167,6 +179,59 @@ def pool_slo_reader(pool, q: float = 99.0, *, window_s: float | None = None):
     return read
 
 
+def file_slo_reader(
+    dir: str,
+    q: float = 99.0,
+    *,
+    metric: str = "serve.ttft_ms",
+    window_s: float | None = None,
+    prefix: str = "metrics_fd_",
+):
+    """An :class:`SloReading` source over METRICS FILES — the
+    cross-process twin of :func:`pool_slo_reader`, for an arbiter whose
+    serving tenant is a fleet of real replica processes it cannot reach
+    into.
+
+    Reads every ``{prefix}*.json`` snapshot in ``dir`` (the front door's
+    :meth:`~flextree_tpu.serving.frontdoor.FrontDoor.write_metrics`
+    per-replica files by default), reconstructs each one's windowed
+    ``metric`` series (:func:`~flextree_tpu.obs.metrics.load_window` —
+    the rolling window survives the file round-trip now; a pre-series
+    payload or torn file contributes NO evidence, never a frozen p99),
+    and merges them into one pool-level reading, aged against the wall
+    clock so a replica that stopped writing decays to empty instead of
+    asserting its last breach forever.  ``window_s`` enforcement matches
+    :func:`pool_slo_reader`: a snapshot whose window spans a different
+    horizon than the breach check claims to read is a loud error."""
+
+    def read() -> SloReading:
+        wins = []
+        for path in sorted(glob.glob(os.path.join(dir, prefix + "*.json"))):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    snap = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-replace / vanished: no evidence this tick
+            payload = (snap.get("histograms") or {}).get(metric)
+            if payload is None:
+                continue
+            fw = load_window(payload)
+            if fw is None:
+                continue  # summary-only payload: absent ≠ clean, skip
+            if window_s is not None and abs(fw.window_s - window_s) > 1e-9:
+                raise ValueError(
+                    f"{os.path.basename(path)}'s {metric} window spans "
+                    f"{fw.window_s:g}s but the arbiter evaluates a "
+                    f"{window_s:g}s lease window — build the writer with "
+                    f"slo_window_s={window_s:g}"
+                )
+            wins.append(fw)
+        p99, n = merged_window_percentile(wins, q, now=time.time())
+        return SloReading(p99_ms=p99, samples=n)
+
+    return read
+
+
 class PoolArbiter:
     """One elastic device pool over a :class:`DeviceInventory` and a
     :class:`~flextree_tpu.runtime.LeaseLedger`.
@@ -197,6 +262,7 @@ class PoolArbiter:
         slo_reader,
         on_serve_grant=None,
         on_serve_return=None,
+        serve_is_tenant: bool = False,
     ):
         self.inventory = inventory
         self.ledger = ledger
@@ -204,7 +270,14 @@ class PoolArbiter:
         self.slo_reader = slo_reader
         self.on_serve_grant = on_serve_grant
         self.on_serve_return = on_serve_return
-        self._pending: dict | None = None  # revoked, awaiting train ack
+        # serving as a LEDGER TENANT: scale-down is a revoke → drain →
+        # ack → grant-back handshake through the ledger (the serving
+        # fleet's ServeLeaseClient drains real replica processes and acks
+        # only after), not a synchronous on_serve_return call — chips
+        # leave serving only once serving provably stopped using them,
+        # exactly the guarantee training already had.
+        self.serve_is_tenant = bool(serve_is_tenant)
+        self._pending: dict | None = None  # parked, awaiting src's ack
         self._loaned: list = []  # chips currently on loan to serving
         self._breach_streak = 0
         self._clear_streak = 0
@@ -220,7 +293,9 @@ class PoolArbiter:
         # keep increasing so no tenant can mistake the old grant for news.
         prior = self.ledger.read()
         self._epoch = 0 if prior is None else prior.epoch + 1
+        self._resume_state(prior)
         self.ledger.publish(self._epoch, inventory.grants(), reason="initial")
+        self._save_state()
         record_event(
             "lease_grant",
             holder=TRAIN,
@@ -228,6 +303,66 @@ class PoolArbiter:
             epoch=self._epoch,
             reason="initial",
         )
+
+    # ---- durable state (the restart-mid-handoff story) ---------------------
+
+    @property
+    def _state_path(self) -> str:
+        return os.path.join(self.ledger.dir, STATE_FILE)
+
+    def _save_state(self) -> None:
+        write_control_json(
+            self.ledger.dir, self._state_path,
+            {
+                "loaned": list(self._loaned),
+                "pending": None if self._pending is None else {
+                    "chips": list(self._pending["chips"]),
+                    "epoch": self._pending["epoch"],
+                    "src": self._pending["src"],
+                    "dst": self._pending["dst"],
+                },
+            },
+        )
+
+    def _resume_state(self, prior) -> None:
+        """Adopt a predecessor's loan/pending bookkeeping, validated
+        against the inventory the caller rebuilt from the ledger — a
+        restart mid-handoff must finish the handoff (the parked chips'
+        destination is state the ledger alone cannot carry), not strand
+        chips on the arbiter holder forever."""
+        if prior is None:
+            return
+        doc = read_control_json(self._state_path)
+        if doc is None:
+            return  # no predecessor state (or torn): start conservative
+        parked = set(self.inventory.held_by(ARBITER))
+        serve = set(self.inventory.held_by(SERVE))
+        loaned = [c for c in doc.get("loaned") or () if c in serve]
+        self._loaned = loaned
+        p = doc.get("pending")
+        if (
+            isinstance(p, dict)
+            and p.get("src") in (TRAIN, SERVE)
+            and p.get("dst") in (TRAIN, SERVE)
+            and p.get("chips")
+            and set(p["chips"]) <= parked
+        ):
+            # the revoke epoch predates our restart; our "initial"
+            # publish below re-announces the same shrunken grant at a
+            # NEWER epoch, and the source tenant's ack of either epoch
+            # proves it applied the revocation — gate on the older one
+            self._pending = {
+                "chips": tuple(p["chips"]),
+                "epoch": int(p["epoch"]),
+                "src": p["src"],
+                "dst": p["dst"],
+            }
+            log.warning(
+                "arbiter restart: resuming handoff of chips %s "
+                "(%s -> %s, revoke epoch %d)",
+                list(self._pending["chips"]), self._pending["src"],
+                self._pending["dst"], self._pending["epoch"],
+            )
 
     # ---- bookkeeping -------------------------------------------------------
 
@@ -330,8 +465,8 @@ class PoolArbiter:
     # ---- actions -----------------------------------------------------------
 
     def _preempt(self, reading: SloReading, now: float):
-        """Phase 1 of the handoff: revoke chips from training (park on
-        the arbiter holder) and wait for training's ack."""
+        """Phase 1 of the scale-up handoff: revoke chips from training
+        (park on the arbiter holder) and wait for training's ack."""
         chips = self.inventory.take(
             TRAIN, self.cfg.burst_chips, keep=self.cfg.min_train_chips
         )
@@ -341,8 +476,11 @@ class PoolArbiter:
             f"slo breach: p99 {reading.p99_ms:.1f}ms > "
             f"{self.cfg.slo_p99_ms:.1f}ms"
         )
-        self._pending = {"chips": chips, "epoch": epoch}
+        self._pending = {
+            "chips": chips, "epoch": epoch, "src": TRAIN, "dst": SERVE,
+        }
         self._last_action_wall = now
+        self._save_state()
         record_event(
             "lease_preempt",
             chips=list(chips),
@@ -359,62 +497,103 @@ class PoolArbiter:
         return "preempt"
 
     def _maybe_complete_handoff(self, reading: SloReading):
-        """Phase 2: once training acked the revocation epoch, hand the
-        parked chips to serving and fire the burst replicas."""
+        """Phase 2 of either handoff direction: once the SOURCE tenant
+        acked the revocation epoch (training: checkpointed + shrunk;
+        serving: replicas drained — its client refuses to ack sooner),
+        hand the parked chips to the destination."""
         pending = self._pending
         # ONE ack read serves both fields — two reads could pair the
         # epoch from one ack version with the control stamp of another
-        ack = self.ledger.read_ack(TRAIN) or {}
+        ack = self.ledger.read_ack(pending["src"]) or {}
         try:
             acked = int(ack["epoch"])
         except (KeyError, ValueError, TypeError):
             acked = -1
         if acked < pending["epoch"]:
-            return None  # trainer still checkpointing/rebuilding: wait
+            return None  # source still checkpointing/draining: wait
         # a coordinated (multi-process) tenant stamps the control epoch it
         # group-applied the revocation under (runtime.coordination's
         # fencing: the ack provably post-dates the apply); single-process
         # tenants leave it None — record whichever the ack carries
         control_epoch = ack.get("control_epoch")
-        chips = self.inventory.move(pending["chips"], ARBITER, SERVE)
-        epoch = self._publish(f"granting {list(chips)} to serving")
-        self._loaned.extend(chips)
+        dst = pending["dst"]
+        chips = self.inventory.move(pending["chips"], ARBITER, dst)
+        epoch = self._publish(f"granting {list(chips)} to {dst}")
+        if dst == SERVE:
+            self._loaned.extend(chips)
+        else:
+            self._loaned = [c for c in self._loaned if c not in chips]
         self._pending = None
         # the grant IS a chip move: the cooldown restarts here, so a
         # burst that ends while the trainer was still checkpointing
         # cannot bounce the chips straight back on the next tick
         self._last_action_wall = _wall()
+        self._save_state()
         record_event(
-            "lease_grant",
+            "lease_grant" if dst == SERVE else "lease_return",
             chips=list(chips),
-            holder=SERVE,
+            holder=dst,
             epoch=epoch,
             control_epoch=control_epoch,
             **reading.to_payload(),
         )
-        if self.on_serve_grant is not None:
+        if dst == SERVE and self.on_serve_grant is not None:
             self.on_serve_grant(chips)
+        if dst == TRAIN and self.on_serve_return is not None:
+            # tenant mode: the fleet already drained before serving's ack
+            # — this hook is notification, not the drain itself
+            self.on_serve_return(chips)
         log.warning(
-            "arbiter: chips %s granted to serving (epoch %d)",
-            list(chips), epoch,
+            "arbiter: chips %s granted to %s (epoch %d)",
+            list(chips), dst, epoch,
         )
-        return "grant"
+        return "grant" if dst == SERVE else "return"
 
     def _return(self, reading: SloReading, now: float):
-        """The burst drained: release the serving replicas (their
-        in-flight requests re-route exactly-once) and return every loaned
-        chip to training, which re-expands on its next lease poll."""
+        """Scale-down.  Tenant mode: phase 1 of the reverse handoff —
+        revoke the loaned chips from serving (park them), publish, and
+        wait for serving's ack (its lease client SIGTERM-drains the
+        replica processes and refuses to ack while requests are in
+        flight).  Legacy in-process mode: drain synchronously via
+        ``on_serve_return`` and move the chips in one tick."""
         chips = tuple(self._loaned)
+        p99_txt = (
+            "-" if math.isnan(reading.p99_ms) else round(reading.p99_ms, 1)
+        )
+        if self.serve_is_tenant:
+            self.inventory.move(chips, SERVE, ARBITER)
+            epoch = self._publish(
+                f"burst drained: reclaiming {list(chips)} from serving "
+                f"(p99 {p99_txt}ms inside "
+                f"{self.cfg.release_frac:.0%} of SLO)"
+            )
+            self._pending = {
+                "chips": chips, "epoch": epoch, "src": SERVE, "dst": TRAIN,
+            }
+            self._last_action_wall = now
+            self._save_state()
+            record_event(
+                "lease_preempt",
+                chips=list(chips),
+                holder_from=SERVE,
+                epoch=epoch,
+                **reading.to_payload(),
+            )
+            log.warning(
+                "arbiter: burst drained — revoking chips %s from serving "
+                "(epoch %d), awaiting drain ack", list(chips), epoch,
+            )
+            return "preempt"
         if self.on_serve_return is not None:
             self.on_serve_return(chips)
         self.inventory.move(chips, SERVE, TRAIN)
         self._loaned.clear()
         epoch = self._publish(
-            f"burst drained: p99 "
-            f"{'-' if math.isnan(reading.p99_ms) else round(reading.p99_ms, 1)}"
+            f"burst drained: p99 {p99_txt}"
             f"ms inside {self.cfg.release_frac:.0%} of SLO"
         )
         self._last_action_wall = now
+        self._save_state()
         record_event(
             "lease_return",
             chips=list(chips),

@@ -39,6 +39,29 @@ class DeviceInventory:
             raise ValueError(f"train grant names unknown chips: {unknown}")
         self._holder = {c: (TRAIN if c in train else SERVE) for c in chips}
 
+    @classmethod
+    def from_grants(cls, grants: dict) -> "DeviceInventory":
+        """Rebuild an inventory from a ledger-shaped grants dict (holder
+        → chip iterable) — the arbiter-restart path: the last published
+        ledger IS the surviving truth about who holds what, parked
+        (``"arbiter"``) chips included, which the ``train=`` constructor
+        cannot express."""
+        holder: dict = {}
+        for h, chips in grants.items():
+            if h not in _HOLDERS:
+                raise ValueError(f"unknown holder {h!r} in grants")
+            for c in chips:
+                if c in holder:
+                    raise ValueError(
+                        f"chip {c!r} granted to both {holder[c]!r} and {h!r}"
+                    )
+                holder[c] = h
+        if not holder:
+            raise ValueError("an inventory needs at least one chip")
+        inv = cls.__new__(cls)
+        inv._holder = holder
+        return inv
+
     @property
     def chips(self) -> tuple:
         return tuple(sorted(self._holder))

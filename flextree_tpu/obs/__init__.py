@@ -28,10 +28,12 @@ timeline in Perfetto.
 
 from .metrics import (
     Counter,
+    FrozenWindow,
     Gauge,
     Histogram,
     MetricsRegistry,
     WindowedHistogram,
+    load_window,
     merged_window_percentile,
     prometheus_exposition,
 )
@@ -66,6 +68,8 @@ __all__ = [
     "Gauge",
     "Histogram",
     "WindowedHistogram",
+    "FrozenWindow",
+    "load_window",
     "merged_window_percentile",
     "prometheus_exposition",
     "MetricsRegistry",

@@ -31,8 +31,10 @@ __all__ = [
     "Gauge",
     "Histogram",
     "WindowedHistogram",
+    "FrozenWindow",
     "MetricsRegistry",
     "DEFAULT_MS_BUCKETS",
+    "load_window",
     "merged_window_percentile",
     "prometheus_exposition",
 ]
@@ -316,6 +318,31 @@ class WindowedHistogram(Histogram):
     def window_count(self, now: float | None = None) -> int:
         return self.window_counts(now)[1]
 
+    def window_slots(self, now: float | None = None) -> list[dict]:
+        """The live slots as ``{"age", "counts", "count", "sum", "min",
+        "max"}`` dicts, ``age`` = how many whole intervals the slot sits
+        behind ``now`` (0 = the current interval).  Ages, not absolute
+        interval indices: the ring is keyed off this process's monotonic
+        clock, which no other process shares — relative age plus a wall
+        stamp is the only coordinate a cross-process reader can use."""
+        now = _now() if now is None else now
+        k = int(now // self.interval_s)
+        out: list[dict] = []
+        with self._lock:
+            for slot in self._slots:
+                if not (k - self.intervals < slot.k <= k) or slot.count == 0:
+                    continue
+                out.append({
+                    "age": k - slot.k,
+                    "counts": list(slot.counts),
+                    "count": slot.count,
+                    "sum": round(slot.sum, 6),
+                    "min": slot.min,
+                    "max": slot.max,
+                })
+        out.sort(key=lambda s: s["age"])
+        return out
+
     def to_payload(self) -> dict:
         p = super().to_payload()
         counts, count, total, minv, maxv = self.window_counts()
@@ -331,8 +358,115 @@ class WindowedHistogram(Histogram):
             "p99": round(
                 _bucket_percentile(99, self.edges, counts, count, minv, maxv), 6
             ) if count else None,
+            # the cross-process series: everything another process needs
+            # to re-answer window_percentile later, aging the slots off
+            # the wall stamp as real time passes (satellite fix: without
+            # these the window died at snapshot() and no file reader —
+            # the arbiter's breach check included — could see a rolling
+            # p99, only this instant's summary)
+            "interval_s": self.interval_s,
+            "intervals": self.intervals,
+            "edges": list(self.edges),
+            "wall": time.time(),
+            "slots": self.window_slots(),
         }
         return p
+
+
+class FrozenWindow:
+    """A :class:`WindowedHistogram`'s rolling window reconstructed from a
+    serialized payload — the read side of the cross-process round-trip.
+
+    Quacks like the live histogram where it matters (``edges``,
+    ``window_s``, ``window_counts``/``window_percentile``), so
+    :func:`merged_window_percentile` merges frozen and live windows with
+    one code path.  The clock, though, is WALL time anchored at the
+    payload's ``wall`` stamp: a slot that was ``age`` intervals old when
+    serialized expires once ``age + elapsed_intervals >= intervals``, so
+    a stale metrics file decays to an empty window instead of asserting
+    its last breach forever (exactly the lazy-expiry rule the live ring
+    applies to its own slots)."""
+
+    def __init__(self, edges, *, interval_s, intervals, wall, slots):
+        self.edges = tuple(float(e) for e in edges)
+        self.interval_s = float(interval_s)
+        self.intervals = int(intervals)
+        self.wall = float(wall)
+        self._slots = [
+            {
+                "age": int(s["age"]),
+                "counts": [int(c) for c in s["counts"]],
+                "count": int(s["count"]),
+                "sum": float(s.get("sum") or 0.0),
+                "min": s.get("min"),
+                "max": s.get("max"),
+            }
+            for s in slots
+        ]
+
+    @property
+    def window_s(self) -> float:
+        return self.interval_s * self.intervals
+
+    def age_s(self, now: float | None = None) -> float:
+        """Seconds of wall clock since the payload was serialized."""
+        now = time.time() if now is None else now
+        return max(0.0, now - self.wall)
+
+    def window_counts(self, now: float | None = None):
+        """Merged ``(counts, count, sum, min, max)`` over the slots still
+        inside the window at wall time ``now`` (default: right now)."""
+        elapsed = int(self.age_s(now) // self.interval_s)
+        counts = [0] * (len(self.edges) + 1)
+        count, total = 0, 0.0
+        minv: float | None = None
+        maxv: float | None = None
+        for slot in self._slots:
+            if slot["age"] + elapsed >= self.intervals:
+                continue
+            for i, c in enumerate(slot["counts"]):
+                counts[i] += c
+            count += slot["count"]
+            total += slot["sum"]
+            if slot["min"] is not None:
+                minv = (
+                    slot["min"] if minv is None else min(minv, slot["min"])
+                )
+            if slot["max"] is not None:
+                maxv = (
+                    slot["max"] if maxv is None else max(maxv, slot["max"])
+                )
+        return counts, count, total, minv, maxv
+
+    def window_percentile(self, q: float, now: float | None = None) -> float:
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile must be in [0, 100], got {q}")
+        counts, count, _, minv, maxv = self.window_counts(now)
+        return _bucket_percentile(q, self.edges, counts, count, minv, maxv)
+
+    def window_count(self, now: float | None = None) -> int:
+        return self.window_counts(now)[1]
+
+
+def load_window(payload: dict) -> FrozenWindow | None:
+    """Reconstruct the rolling window from one histogram payload (the
+    dict under ``snapshot()["histograms"][name]``).  ``None`` when the
+    payload carries no windowed series — a plain histogram, or a file
+    written before the series existed (absent ≠ empty window: the caller
+    must treat it as "no windowed evidence", not "all clear")."""
+    window = payload.get("window") if isinstance(payload, dict) else None
+    if not isinstance(window, dict) or "slots" not in window:
+        return None
+    try:
+        return FrozenWindow(
+            window["edges"],
+            interval_s=window["interval_s"],
+            intervals=window["intervals"],
+            wall=window["wall"],
+            slots=window["slots"],
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
 
 
 def merged_window_percentile(
@@ -438,12 +572,22 @@ def prometheus_exposition(snapshots: dict, prefix: str = "flextree_") -> str:
             rows.append(f"{n}_count{lbl} {total}")
             window = h.get("window")
             if isinstance(window, dict):
-                wn = n + "_window_p99"
                 p99 = window.get("p99")
+                count = window.get("count", 0)
+                # a payload carrying the windowed series re-answers at
+                # READ time, aged off the wall stamp — a scrape of a
+                # stale metrics file must see the window drain, not the
+                # last write's summary frozen forever
+                frozen = load_window(h)
+                if frozen is not None:
+                    v = frozen.window_percentile(99.0)
+                    p99 = None if math.isnan(v) else round(v, 6)
+                    count = frozen.window_count()
+                wn = n + "_window_p99"
                 if p99 is not None:
                     emit(wn, "gauge", f"{wn}{lbl} {p99}")
                 wc = n + "_window_count"
-                emit(wc, "gauge", f"{wc}{lbl} {window.get('count', 0)}")
+                emit(wc, "gauge", f"{wc}{lbl} {count}")
 
     out: list[str] = []
     for name in sorted(lines_by_name):

@@ -63,6 +63,8 @@ from .leases import (
     LeaseGrant,
     LeaseLedger,
     ResizeDirective,
+    ServeDirective,
+    ServeLeaseClient,
     TrainLeaseClient,
 )
 from .preemption import BackgroundSaver, PreemptionGuard
@@ -95,6 +97,8 @@ __all__ = [
     "LeaseGrant",
     "LeaseLedger",
     "ResizeDirective",
+    "ServeDirective",
+    "ServeLeaseClient",
     "TrainLeaseClient",
     "TRAIN",
     "SERVE",

@@ -51,6 +51,8 @@ __all__ = [
     "LeaseGrant",
     "LeaseLedger",
     "ResizeDirective",
+    "ServeDirective",
+    "ServeLeaseClient",
     "TrainLeaseClient",
 ]
 
@@ -378,6 +380,175 @@ class TrainLeaseClient:
                 "control epoch — a coordinated tenant may only ack resizes "
                 "it applied through the group protocol"
             )
+        self._adopt(
+            directive.epoch, directive.chips,
+            control_epoch=directive.control_epoch,
+        )
+
+    @property
+    def chips(self) -> tuple:
+        return self._chips or ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeDirective:
+    """A serving-grant change the fleet has not applied yet: the new chip
+    set, split into what was gained and what was revoked relative to the
+    fleet the manager currently runs.
+
+    ``revoked`` chips carry the hard sequencing rule of the whole
+    protocol: the manager must DRAIN the replicas on them (SIGTERM →
+    drain-refusals → exit) before the epoch may be acked, because the ack
+    is what releases those chips onward to training.  ``control_epoch``
+    mirrors training's fencing: a coordinated (multi-process) serving
+    tenant may only ack epochs it group-applied."""
+
+    epoch: int
+    chips: tuple
+    added: tuple = ()
+    revoked: tuple = ()
+    reason: str = ""
+    control_epoch: int | None = None
+
+    @property
+    def n(self) -> int:
+        return len(self.chips)
+
+
+class ServeLeaseClient:
+    """Serving's lease handle — the :class:`TrainLeaseClient` twin.
+
+    Where training's "apply" is a checkpoint → mesh rebuild → restore,
+    serving's is a real-process fleet change: ``on_grant(chips)`` spawns
+    a warmed ``replica_main.py`` process per gained chip (its endpoint
+    file registers it with the front door), ``on_revoke(chips)``
+    SIGTERM-drains the replicas on the revoked chips and returns only
+    once the drain completed (every queued/in-flight request answered
+    with a drain refusal the front door re-routes exactly-once).
+
+    The ack is double-fenced:
+
+    - **drain fence** — ``inflight`` (optional callable → the number of
+      requests still in flight on the revoked replicas) is consulted at
+      :meth:`ack`; a revocation acked while requests are in flight is a
+      :class:`~flextree_tpu.runtime.coordination.ProtocolViolation`,
+      never a written ack.  This is the real-code twin of the lease
+      model's ``serve-ack-before-drain`` mutation — the ledger handshake
+      only protects chips if "acked" implies "no longer using them".
+    - **control-epoch fence** — exactly like training's: a coordinated
+      tenant's directive must carry the committed control epoch, or the
+      ack is refused loudly.
+
+    The client never spawns or signals anything itself — sequencing
+    lives here, process mechanics live in the hooks — so tests can bind
+    it to the protocol model with toy hooks and the chaos driver can
+    bind the same object to real processes.
+    """
+
+    def __init__(
+        self,
+        ledger: LeaseLedger,
+        *,
+        holder: str = SERVE,
+        on_grant: Callable | None = None,
+        on_revoke: Callable | None = None,
+        inflight: Callable | None = None,
+        initial_chips=None,
+        poll_interval_s: float = 0.2,
+        coordination=None,
+        _mono=time.monotonic,
+    ):
+        self.ledger = ledger
+        self.holder = holder
+        self.on_grant = on_grant
+        self.on_revoke = on_revoke
+        self.inflight = inflight
+        self.poll_interval_s = float(poll_interval_s)
+        self.coordination = coordination
+        self._mono = _mono
+        self._next_poll = 0.0
+        self._applied_epoch = -1
+        # the fleet the manager actually runs.  Pass it whenever you know
+        # it (a restarted manager reconciling against live replica
+        # processes does): with it, a first poll that reads a different
+        # grant — a revoke published while we were down, a restart
+        # mid-handoff — is a directive like any other.  Without it, the
+        # first observation is trusted as the running fleet.
+        self._chips: tuple | None = (
+            tuple(sorted(initial_chips)) if initial_chips is not None
+            else None
+        )
+
+    def poll(self) -> ServeDirective | None:
+        """A pending grant change, or None.  Throttled file read; an
+        epoch whose chip set matches the running fleet is acked in place
+        (e.g. the publish that returned OUR former chips to training —
+        our slice did not change again)."""
+        now = self._mono()
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + self.poll_interval_s
+        grant = self.ledger.read()
+        if grant is None or grant.epoch <= self._applied_epoch:
+            return None
+        chips = grant.chips(self.holder)
+        if self._chips is None:
+            self._adopt(grant.epoch, chips)
+            return None
+        if chips == self._chips:
+            self._adopt(grant.epoch, chips)  # epoch moved, our slice didn't
+            return None
+        cur = set(self._chips)
+        new = set(chips)
+        return ServeDirective(
+            epoch=grant.epoch,
+            chips=chips,
+            added=tuple(sorted(new - cur)),
+            revoked=tuple(sorted(cur - new)),
+            reason=grant.reason,
+        )
+
+    def apply(self, directive: ServeDirective) -> None:
+        """Drive one directive end to end in protocol order: drain the
+        revoked replicas FIRST (the ack below is what releases their
+        chips onward), then spawn onto the gained chips, then ack."""
+        if directive.revoked and self.on_revoke is not None:
+            self.on_revoke(directive.revoked)
+        if directive.added and self.on_grant is not None:
+            self.on_grant(directive.added)
+        self.ack(directive)
+
+    def _adopt(
+        self, epoch: int, chips: tuple, control_epoch: int | None = None
+    ) -> None:
+        self._applied_epoch = epoch
+        self._chips = chips
+        self.ledger.ack(self.holder, epoch, control_epoch=control_epoch)
+
+    def ack(self, directive: ServeDirective) -> None:
+        """The fleet now matches ``directive``: acknowledge the epoch so
+        the arbiter may hand the revoked chips on.  Refuses loudly — no
+        ack is written — if requests are still in flight on a revocation
+        (the drain fence) or, under coordination, if the directive does
+        not carry the committed control epoch."""
+        from .coordination import ProtocolViolation
+
+        if self.coordination is not None and directive.control_epoch is None:
+            raise ProtocolViolation(
+                f"lease epoch {directive.epoch} acked without a committed "
+                "control epoch — a coordinated tenant may only ack resizes "
+                "it applied through the group protocol"
+            )
+        if directive.revoked and self.inflight is not None:
+            n = int(self.inflight())
+            if n > 0:
+                raise ProtocolViolation(
+                    f"lease epoch {directive.epoch} revokes chips "
+                    f"{list(directive.revoked)} but {n} request(s) are "
+                    "still in flight — acking now would release the chips "
+                    "while replicas are mid-request (serve-ack-before-"
+                    "drain); drain first"
+                )
         self._adopt(
             directive.epoch, directive.chips,
             control_epoch=directive.control_epoch,

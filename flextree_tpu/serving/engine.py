@@ -447,6 +447,121 @@ class ServingEngine:
         idx = self.batcher.prefix_index
         return idx.clear() if idx is not None else 0
 
+    # ---- prefix-warm drain handoff -----------------------------------------
+
+    def _block_hash(self, block: int) -> str:
+        """CRC32 over a block's K and V bytes across every layer — the
+        content witness a handoff successor checks its RECOMPUTED block
+        against (block bytes are a pure function of the token prefix, so
+        agreeing hashes mean the warm cache really is the same cache)."""
+        import zlib
+
+        crc = 0
+        for kind in ("k", "v"):
+            for layer in self.pools[kind]:
+                crc = zlib.crc32(np.asarray(layer[block]).tobytes(), crc)
+        return f"{crc & 0xFFFFFFFF:08x}"
+
+    def export_prefix_handoff(self) -> dict | None:
+        """Serialize the prefix index for a drain handoff: every node as
+        its root-to-node token prefix plus the content hash of its block.
+        Token ids and hashes travel; block ids and raw K/V bytes never do
+        — the successor RECOMPUTES each block from the prefix and uses
+        the hash to prove it rebuilt the same bytes.  Returns ``None``
+        when the prefix cache is disabled."""
+        idx = self.batcher.prefix_index
+        if idx is None:
+            return None
+        entries = [
+            {
+                "prefix": [int(t) for key in path for t in key],
+                "hash": self._block_hash(block),
+            }
+            for path, block in idx.node_paths()
+        ]
+        self.metrics.counter("serve.handoff_exported_blocks").inc(
+            len(entries)
+        )
+        record_event("serve_handoff_export", entries=len(entries))
+        return {
+            "version": 1,
+            "block_size": self.pcfg.block_size,
+            "entries": entries,
+        }
+
+    def prewarm_prefix_from_handoff(self, doc) -> dict:
+        """Rebuild a predecessor's prefix cache from its handoff export:
+        recompute each prefix's last block via prefill, verify the bytes
+        against the recorded content hash, and adopt verified blocks into
+        this replica's index BEFORE traffic arrives.  A hash mismatch
+        refuses that entry (and, since children need their parent chain,
+        its whole subtree) — a corrupt handoff degrades to a cold start,
+        never to serving wrong K/V.  Returns stats counters."""
+        stats = {"inserted": 0, "skipped": 0, "hash_mismatches": 0,
+                 "refused": None}
+        idx = self.batcher.prefix_index
+        if idx is None:
+            stats["refused"] = "prefix cache disabled"
+            return stats
+        bs = self.pcfg.block_size
+        if (
+            not isinstance(doc, dict)
+            or doc.get("version") != 1
+            or int(doc.get("block_size", -1)) != bs
+            or not isinstance(doc.get("entries"), list)
+        ):
+            stats["refused"] = "incompatible handoff payload"
+            self.metrics.counter("serve.handoff_refused").inc()
+            record_event("serve_handoff_refused",
+                         reason=stats["refused"])
+            return stats
+        alloc = self.batcher.allocator
+        # parents sort before their children (tuple-prefix order), so a
+        # single pass builds chains bottom-up; keep one sequence's worth
+        # of blocks free so prewarming can never starve first admission
+        reserve = self.pcfg.blocks_per_seq
+        for e in sorted(doc["entries"], key=lambda e: len(e["prefix"])):
+            prefix = e.get("prefix")
+            if (
+                not isinstance(prefix, list) or not prefix
+                or len(prefix) % bs != 0
+            ):
+                stats["skipped"] += 1
+                continue
+            tokens = np.asarray(prefix, np.int32)
+            n = len(prefix) // bs
+            matched = idx.match(tokens)
+            if len(matched) >= n:
+                continue  # already warm (shared parent of two subtrees)
+            if len(matched) < n - 1:
+                stats["skipped"] += 1  # parent refused/missing upstream
+                continue
+            if alloc.num_free <= reserve:
+                stats["skipped"] += 1
+                continue
+            [b] = alloc.alloc(1)
+            _, cache = self._prefill(self.params, tokens[None])
+            self.pools = self._write_at(
+                self.pools, cache, np.asarray([b], np.int32), n - 1
+            )
+            want = e.get("hash")
+            if want is not None and self._block_hash(b) != want:
+                alloc.release([b])
+                stats["hash_mismatches"] += 1
+                self.metrics.counter("serve.handoff_hash_mismatch").inc()
+                record_event(
+                    "serve_handoff_hash_mismatch", prefix_len=len(prefix)
+                )
+                continue
+            idx.insert(tokens, matched + [b])
+            alloc.release([b])  # the index's retain is now the holder
+            stats["inserted"] += 1
+        self.metrics.counter("serve.handoff_prewarmed_blocks").inc(
+            stats["inserted"]
+        )
+        record_event("serve_handoff_prewarm", **stats)
+        return stats
+
     def _prefill_slot(self, slot: int, state: SeqState) -> None:
         t0 = _now()
         req = state.request

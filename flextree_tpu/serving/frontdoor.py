@@ -98,8 +98,14 @@ class FrontDoorConfig:
     # breaker
     breaker_strikes: int = 3
     breaker_cooldown_s: float = 2.0
-    # shedding
+    # shedding: over ``shed_outstanding`` in flight, intake refuses —
+    # but PREDICTED PREFIX HITS (first block hashed in the affinity
+    # table) ride a further ``shed_hit_headroom`` of slack.  A hit costs
+    # a fraction of a miss's prefill, so when something must be shed,
+    # shedding the miss first buys more admitted tokens per unit of
+    # capacity; 0 restores hit-blind shedding.
     shed_outstanding: int = 64
+    shed_hit_headroom: int = 16
     # prefix affinity: requests whose first ``affinity_span`` prompt
     # tokens hash alike PREFER the replica that last completed one (its
     # prefix index is warm there) — a preference only, never overriding
@@ -367,26 +373,39 @@ class FrontDoor:
         here — a retried / hedged / re-routed request keeps it, so TTFT
         includes every queue and recovery second.  Returns False on an
         intake shed (accounted, never silently dropped)."""
+        p = np.asarray(prompt, np.int32)
+        span = self.cfg.affinity_span
+        phash = None
+        if span > 0 and len(p) > span:
+            # hash exactly the first cacheable block span; prompts no
+            # longer than it can't share a FULL cached block, so routing
+            # them by affinity would buy nothing.  Computed BEFORE the
+            # shed decision: whether this is a predicted hit decides how
+            # much headroom it gets
+            phash = zlib.crc32(p[:span].tobytes())
         with self._lock:
             inflight = len(self._inflight)
-            if inflight >= self.cfg.shed_outstanding:
+            headroom = self.cfg.shed_hit_headroom
+            hit = phash is not None and phash in self._affinity
+            limit = self.cfg.shed_outstanding + (headroom if hit else 0)
+            if inflight >= limit:
                 self.metrics.counter("serve.shed").inc()
+                if not hit and inflight < (
+                    self.cfg.shed_outstanding + headroom
+                ):
+                    # a predicted hit at this load would have been
+                    # admitted: this shed is the miss-first policy acting
+                    self.metrics.counter("serve.shed_miss_first").inc()
                 self.shed_rids.append(rid)
                 record_event(
                     "serve_shed", rid=rid, where="frontdoor",
                     inflight=inflight, reason="FT_RPC_SHED",
+                    predicted_hit=hit,
                 )
                 return False
             self._arrival.setdefault(rid, _now())
             self._inflight.add(rid)
-        p = np.asarray(prompt, np.int32)
-        span = self.cfg.affinity_span
-        if span > 0 and len(p) > span:
-            # hash exactly the first cacheable block span; prompts no
-            # longer than it can't share a FULL cached block, so routing
-            # them by affinity would buy nothing
-            phash = zlib.crc32(p[:span].tobytes())
-            with self._lock:
+            if phash is not None:
                 self._rid_phash[rid] = phash
         self._work.put((rid, p, int(max_new_tokens)))
         return True
@@ -606,6 +625,46 @@ class FrontDoor:
             self._deliver(rid, reply, rep, send_mono, hedged)
             return ("done",)
         return ("retry", last_code)
+
+    # ---- elasticity (scale events from the lease driver) -------------------
+
+    def reassign_affinity(self, old_rank: int, new_rank: int) -> int:
+        """Point every prefix-affinity entry at ``old_rank`` to
+        ``new_rank`` — the routing half of a prefix-warm drain handoff:
+        the successor pre-warmed its index from the drainer's export, so
+        the requests that used to hit the drainer should hit it.  Returns
+        how many entries moved."""
+        with self._lock:
+            moved = [
+                ph for ph, r in self._affinity.items() if r == old_rank
+            ]
+            for ph in moved:
+                self._affinity[ph] = new_rank
+        if moved:
+            self.metrics.counter("serve.affinity_handoff").inc(len(moved))
+            record_event(
+                "serve_affinity_handoff", old=int(old_rank),
+                new=int(new_rank), entries=len(moved),
+            )
+        return len(moved)
+
+    def forget_replica(self, rank: int) -> None:
+        """Drop a cleanly-departed replica: close its connection, remove
+        its client, and clear any affinity entries still naming it (a
+        stale preference is harmless — ``_routable`` falls back — but a
+        clean exit should not leave one).  A crashed replica needs no
+        call: membership marks it DEAD and routing skips it."""
+        with self._lock:
+            client = self.clients.pop(rank, None)
+            stale = [
+                ph for ph, r in self._affinity.items() if r == rank
+            ]
+            for ph in stale:
+                del self._affinity[ph]
+        if client is not None:
+            client.close()
+        record_event("serve_forget_replica", rank=int(rank),
+                     stale_affinity=len(stale))
 
     # ---- results -----------------------------------------------------------
 

@@ -265,12 +265,20 @@ class PrefixIndex:
         """Every root-to-node key path, sorted — the deterministic-keying
         witness: two replicas fed the same requests produce identical
         paths (block ids may differ; the KEYS are the contract)."""
+        return [path for path, _ in self.node_paths()]
+
+    def node_paths(self) -> list:
+        """Every ``(root-to-node key path, block id)`` pair, sorted by
+        path — the drain-handoff export walk: the path IS the token
+        prefix the node's block was computed from, so a successor can
+        recompute the block from the path alone (the block id is local
+        to THIS replica's pool and never travels)."""
         out = []
         stack = [((), self._children)]
         while stack:
             prefix, children = stack.pop()
             for key, node in children.items():
                 path = prefix + (key,)
-                out.append(path)
+                out.append((path, node.block))
                 stack.append((path, node.children))
         return sorted(out)

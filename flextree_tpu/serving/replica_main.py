@@ -117,6 +117,10 @@ class ReplicaServer:
         self._stop = threading.Event()
         self.draining = threading.Event()
         self.drained = threading.Event()
+        # optional drain hook (e.g. the prefix handoff export), invoked
+        # on the ENGINE thread after refusals, before ``drained`` is set
+        # — whatever it writes is durably on disk before any drain ack
+        self.on_drain = None
         self.port: int | None = None
         self._sent_frames = 0
         tear = os.environ.get(FT_RPC_TEAR_EVERY_ENV)
@@ -389,6 +393,14 @@ class ReplicaServer:
         self.engine.metrics.counter("serve.drain_refusals").inc(n)
         record_event("drain", rank=self.cfg.rank, refused=n,
                      reason="sigterm")
+        if self.on_drain is not None:
+            try:
+                self.on_drain()
+            except Exception as e:  # a failed export must not wedge drain
+                log.warning("replica %d drain hook failed: %s",
+                            self.cfg.rank, e)
+                record_event("serve_drain_hook_failed", rank=self.cfg.rank,
+                             error=str(e))
         log.info("replica %d drained: %d refusals", self.cfg.rank, n)
         self.drained.set()
 
@@ -448,6 +460,13 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup-suffix-lens", default="",
                     help="CSV of cached:suffix pairs (e.g. 32:4,32:12) to "
                          "compile the suffix prefill for before serving")
+    ap.add_argument("--handoff-out", default="",
+                    help="on drain, export the prefix index (token "
+                         "prefixes + block content hashes) to this file")
+    ap.add_argument("--handoff-in", default="",
+                    help="at boot, pre-warm the prefix cache from a "
+                         "predecessor's handoff export (checksum-refused "
+                         "or missing file degrades to a cold start)")
     args = ap.parse_args(argv)
 
     import jax
@@ -493,9 +512,38 @@ def main(argv=None) -> int:
         max_pending=args.max_pending,
     )
     server = ReplicaServer(engine, rcfg)
+    if args.handoff_out:
+
+        def _export_handoff() -> None:
+            doc = engine.export_prefix_handoff()
+            if doc is not None:
+                write_control_json(args.dir, args.handoff_out, doc)
+
+        server.on_drain = _export_handoff
     with flight_recorder(
         args.dir, args.rank, source="serve", registry=engine.metrics
     ) as rec:
+        # inside the recorder, so a cold start is LOUD in the flight
+        # record (the driver's floor), not just in the exit counters
+        if args.handoff_in:
+            from ..runtime.ctrlfile import read_control_json
+
+            doc = read_control_json(args.handoff_in)
+            if doc is None:
+                # missing or checksum-refused: COLD START, never guessing
+                # at corrupt bytes — the successor serves correctly, just
+                # slower
+                engine.metrics.counter("serve.handoff_cold_start").inc()
+                record_event("serve_handoff_cold_start", rank=args.rank,
+                             path=args.handoff_in)
+                log.warning("replica %d: handoff %s absent/refused — "
+                            "cold start", args.rank, args.handoff_in)
+            else:
+                stats = engine.prewarm_prefix_from_handoff(doc)
+                record_event("serve_handoff_prewarm", rank=args.rank,
+                             **stats)
+                log.info("replica %d pre-warmed from %s: %s", args.rank,
+                         args.handoff_in, stats)
         signal.signal(signal.SIGTERM, lambda s, f: server.initiate_drain())
         install_signal_dump(rec, (signal.SIGTERM,))
         with Supervisor(SupervisorConfig.from_env(args.rank, args.dir)) as sup:
@@ -511,6 +559,16 @@ def main(argv=None) -> int:
             finally:
                 sup.record_step(engine.steps)
             server.stop()
+    if server.drained.is_set():
+        # a CLEAN drain retires the endpoint so discovery stops routing
+        # here (a crash leaves it — the front door's strike/avoid logic
+        # and the heartbeat DEAD classification cover that path)
+        try:
+            os.unlink(os.path.join(
+                args.dir, ENDPOINT_FMT.format(rank=args.rank)
+            ))
+        except OSError:
+            pass
     # a drain exit is a SUCCESS (rc 0): the front door re-routed our work
     return 0
 

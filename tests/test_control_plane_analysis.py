@@ -44,7 +44,13 @@ from flextree_tpu.runtime.coordination import (
     decision_fingerprint,
 )
 from flextree_tpu.runtime.lease_model import LEASE_MUTATIONS, LeaseModel
-from flextree_tpu.runtime.leases import ARBITER, SERVE, TRAIN, LeaseLedger
+from flextree_tpu.runtime.leases import (
+    ARBITER,
+    SERVE,
+    TRAIN,
+    LeaseLedger,
+    ServeLeaseClient,
+)
 from flextree_tpu.serving.rpc import RpcConnRefused, RpcShed, RpcTimeout
 from flextree_tpu.serving.rpc_model import (
     FAIL_CODES,
@@ -62,7 +68,7 @@ STATE_SPACE_PINS = {
     "coordination@2ranks": (1009, 1737),
     "coordination@3ranks": (11640, 24916),
     "coordination@4ranks": (61499, 150448),
-    "lease@2chips": (1574, 4898),
+    "lease@2chips": (21250, 70584),
     "rpc@2replicas": (3445, 12301),
 }
 
@@ -176,6 +182,13 @@ MUTATION_REACHABILITY = {
     "torn_ack_read": (
         lambda: LeaseModel(mutation="torn_ack_read"),
         {"torn-ack-read"},
+    ),
+    # serving's drain fence removed: the revocation ack is written while
+    # requests are still decoding on the revoked chips, so the grant
+    # hands training chips serving is actively using
+    "serve_ack_before_drain": (
+        lambda: LeaseModel(mutation="serve_ack_before_drain"),
+        {"dual-holder-use"},
     ),
     "replay_miss": (
         lambda: RpcModel(mutation="replay_miss"),
@@ -325,6 +338,55 @@ class TestModelConformance:
         got = led.read()
         assert got.epoch == 3
         assert got.chips(SERVE) == ("c1",)
+
+    def test_serve_drain_fence_matches_model(self, tmp_path):
+        """The ``serve_ack_before_drain`` mutation removes exactly this
+        fence — prove the real ``ServeLeaseClient`` HAS it: a revocation
+        acked with requests still in flight is a ProtocolViolation and
+        writes nothing; once drained, the same ack lands and the grant
+        gate opens (the model's reverse-handoff trace on the real
+        ledger)."""
+        led = LeaseLedger(str(tmp_path))
+        led.publish(1, {TRAIN: ("c0",), SERVE: ("c1",), ARBITER: ()})
+        inflight = {"n": 2}
+        client = ServeLeaseClient(
+            led, inflight=lambda: inflight["n"],
+            initial_chips=("c1",), poll_interval_s=0.0,
+        )
+        # reverse phase 1 (return): serving's chip parks on the arbiter
+        led.publish(2, {TRAIN: ("c0",), SERVE: (), ARBITER: ("c1",)})
+        d = client.poll()
+        assert d is not None and d.revoked == ("c1",)
+        with pytest.raises(ProtocolViolation, match="in flight"):
+            client.ack(d)
+        assert led.acked_epoch(SERVE) < 2  # the fence wrote NO ack
+        inflight["n"] = 0  # drain completed
+        client.ack(d)
+        assert led.acked_epoch(SERVE) >= 2  # the grant gate opens
+        # reverse phase 2: the parked chip reaches training
+        led.publish(3, {TRAIN: ("c0", "c1"), SERVE: (), ARBITER: ()})
+        assert led.read().chips(TRAIN) == ("c0", "c1")
+
+    def test_serve_restart_mid_handoff_matches_model(self, tmp_path):
+        """The model's ``restart(serve)`` transition on the real client:
+        a manager restarted mid-handoff (revocation published while it
+        was down) reconciles against its live fleet, drains, acks, and
+        the wedged handoff completes."""
+        led = LeaseLedger(str(tmp_path))
+        led.publish(1, {TRAIN: ("c0",), SERVE: ("c1",), ARBITER: ()})
+        led.publish(2, {TRAIN: ("c0",), SERVE: (), ARBITER: ("c1",)})
+        drained = []
+        client = ServeLeaseClient(
+            led, initial_chips=("c1",), poll_interval_s=0.0,
+            on_revoke=lambda chips: drained.append(tuple(chips)),
+            inflight=lambda: 0,
+        )
+        d = client.poll()
+        assert d is not None and d.revoked == ("c1",)
+        client.apply(d)
+        assert drained == [("c1",)]
+        assert led.acked_epoch(SERVE) >= 2
+        assert client.chips == ()
 
 
 # ------------------------------------------------- concurrency-lint units

@@ -184,51 +184,17 @@ def _pcfg() -> PagedCacheConfig:
 
 
 # ---------------------------------------------------------------------------
-# workload: three-phase open-loop Poisson arrivals
+# workload: three-phase open-loop Poisson arrivals — the generator is
+# shared with tools/serve_elastic_chaos.py (flextree_tpu.serving.workload)
+# so the two elastic drivers cannot drift apart on what "a burst" means
 # ---------------------------------------------------------------------------
 
-PROMPT_LENS = (4, 6, 8)
-# decode-heavy mixed outputs: mean ~29 tokens = ~190 ms of service at the
-# measured round time, so 2 slots/replica caps one replica near 11 rps
-OUT_LENS = (16, 32, 48)
-OUT_PROBS = (0.4, 0.35, 0.25)
-
-
-def build_workload(seed, base_rate, spike_rate, t_base, t_spike, t_tail):
-    """Requests with ``arrival_s`` offsets covering baseline → spike →
-    baseline; returns ``(requests, spike_start_s, spike_end_s)``."""
-    rng = np.random.default_rng(seed)
-    arrivals = []
-    t = 0.0
-    while t < t_base:
-        t += rng.exponential(1.0 / base_rate)
-        if t < t_base:
-            arrivals.append(t)
-    spike_start = t_base
-    t = 0.0
-    while t < t_spike:
-        t += rng.exponential(1.0 / spike_rate)
-        if t < t_spike:
-            arrivals.append(spike_start + t)
-    spike_end = spike_start + t_spike
-    t = 0.0
-    while t < t_tail:
-        t += rng.exponential(1.0 / base_rate)
-        if t < t_tail:
-            arrivals.append(spike_end + t)
-    requests = []
-    for i, a in enumerate(sorted(arrivals)):
-        p = int(rng.choice(PROMPT_LENS))
-        m = int(rng.choice(OUT_LENS, p=OUT_PROBS))
-        requests.append(
-            Request(
-                rid=i,
-                prompt=rng.integers(0, 128, (p,)).astype(np.int32),
-                max_new_tokens=m,
-                arrival_s=float(a),
-            )
-        )
-    return requests, spike_start, spike_end
+from flextree_tpu.serving.workload import (  # noqa: E402
+    OUT_LENS,
+    OUT_PROBS,
+    PROMPT_LENS,
+    build_spike_workload as build_workload,
+)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,1209 @@
+#!/usr/bin/env python
+"""Executed chaos proof for ELASTIC SERVING TENANCY: a real-process
+replica fleet holding chips through the lease ledger
+(``runtime/leases.py`` :class:`ServeLeaseClient`), scaled by the
+:class:`~flextree_tpu.arbiter.PoolArbiter` off cross-process windowed
+SLO metrics files, with prefix-warm drain handoffs
+(``docs/ARBITER.md``, ``docs/FAILURE_MODEL.md``).
+
+Every scenario runs REAL replica processes
+(``python -m flextree_tpu.serving.replica_main``) behind a real
+:class:`FrontDoor` over real TCP, and each floor is machine-checked
+(non-zero exit on any violation):
+
+- ``elastic_autoscale`` — the tentpole end-to-end: a real jitted
+  sharded trainer (``fit(arbiter=TrainLeaseClient(...))``, dp-3) and a
+  one-replica serving fleet share 4 chips; an open-loop Poisson burst
+  breaches the windowed TTFT p99 the arbiter reads from
+  ``metrics_fd_*.json`` snapshots; the arbiter preempts 2 training
+  chips, training checkpoints/shrinks (bitwise resume), the serve grant
+  activates pre-warmed standby replicas; p99 recovers within a bounded
+  number of lease windows; sustained clear returns the chips — the
+  revoked replicas SIGTERM-drain (in-flight work re-routed
+  exactly-once) BEFORE the serve ack releases the chips — and training
+  re-expands, bitwise again.  Every observed ledger publish must hold
+  one-holder-per-chip.
+- ``prefix_handoff_warm_vs_cold`` — a draining replica exports its
+  radix prefix index (prefix tokens + block-content hashes, never raw
+  KV); the successor pre-warms admission from it and the front door's
+  affinity table follows.  The successor's first-touch prefix hit rate
+  must reach >=50% of the predecessor's, against a cold-successor
+  negative control on the identical workload.
+- ``sigkill_mid_handoff`` — SIGKILL the drainer before the export
+  lands: the handoff file must be ABSENT (a torn export is refused by
+  the checksum trailer, a missing one is loud), the successor cold
+  starts (``serve_handoff_cold_start``), and every in-flight request
+  still completes exactly once, bitwise.
+- ``arbiter_restart_mid_grant`` — kill the arbiter between parking
+  chips and completing the handoff; the restarted arbiter rebuilds its
+  inventory from the ledger, resumes the pending handoff from
+  ``arbiter_state.json``, completes the grant, and a real replica
+  serves on the granted chip.  No chip is ever double-held.
+- ``drain_races_revoke`` — a revoke lands while requests are in flight
+  on the revoked chip: acking immediately must raise
+  ``ProtocolViolation`` (the drain fence — the real-code twin of the
+  lease model's ``serve-ack-before-drain`` mutation) with NO ack
+  written; the drain-then-ack path completes exactly-once.
+- ``shed_miss_first_load`` — prefix-aware shedding A/B at the SAME
+  max-inflight cap: reserving headroom for predicted prefix hits
+  (``shed_outstanding=4, shed_hit_headroom=4``) must beat the flat
+  policy (``8, 0``) on admitted-token throughput, with the shed misses
+  accounted in ``serve.shed_miss_first``.
+
+Usage: python tools/serve_elastic_chaos.py [--smoke] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from collections import deque
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+sys.path.insert(0, REPO)
+sys.path.insert(1, TOOLS)
+
+# arbiter_spike pins jax to 4 virtual CPU devices at import — that must
+# happen before anything initializes the backend (the oracle, the
+# trainer), so both tool modules load first
+import arbiter_spike as spike  # noqa: E402
+import rpc_chaos as rc  # noqa: E402
+import numpy as np  # noqa: E402
+
+_mono = time.monotonic
+
+WINDOW_S = 6.0
+TICK_S = 0.4
+# the front-door TTFT is time-to-result over the RPC (the replica
+# answers one call with the whole generation), so the SLO must sit above
+# an UNLOADED completion (~0.5 s at the paced rounds below) and the
+# breach evidence is pure queueing delay
+SLO_P99_MS = 1500.0
+CHIPS = (0, 1, 2, 3)
+TRAIN_CHIPS = (0, 1, 2)  # chip 3 is serving's baseline replica
+BURST_CHIPS = 2
+# decode pacing (FT_RPC_DECODE_SLEEP): the tiny CPU model decodes in
+# sub-ms rounds, so capacity would be a function of host scheduler luck;
+# a fixed per-round sleep maps capacity to replica count instead (~7 rps
+# per replica at 4 slots and a ~29-round mean output) — the same honest
+# limit arbiter_spike documents for its in-process pool
+DECODE_SLEEP = "0.02"
+READY_TIMEOUT_S = 240.0
+
+
+def _strk(d: dict) -> dict:
+    """Rank-keyed dicts get string keys before landing in the artifact
+    (sort_keys chokes on int keys mixed with str annotations)."""
+    return {str(k): v for k, v in d.items()}
+
+
+def _spawn(
+    ctrl: str,
+    rank: int,
+    extra_env=None,
+    extra_args=(),
+    warm_lens=rc.PROMPT_LENS,
+    warm_max_new: int = 48,
+    suffix_lens: str = "",
+) -> subprocess.Popen:
+    cmd = [
+        sys.executable, "-m", "flextree_tpu.serving.replica_main",
+        "--rank", str(rank), "--dir", ctrl,
+        "--max-pending", "64",
+        "--warmup-prompt-lens", ",".join(str(t) for t in warm_lens),
+        "--warmup-max-new", str(warm_max_new),
+        *rc.MODEL_ARGS,
+        *extra_args,
+    ]
+    if suffix_lens:
+        cmd += ["--warmup-suffix-lens", suffix_lens]
+    return subprocess.Popen(
+        cmd, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _submit(fd, req) -> bool:
+    return fd.submit(req["rid"], req["prompt"], req["max_new"])
+
+
+def _as_req(r) -> dict:
+    """serving.workload Request -> the oracle/submit dict shape."""
+    return {
+        "rid": r.rid, "prompt": np.asarray(r.prompt, np.int32),
+        "max_new": r.max_new_tokens,
+    }
+
+
+def _prefix_pool(seed: int, n: int, length: int = 32) -> list:
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, 64, (length,)).astype(np.int32) for _ in range(n)
+    ]
+
+
+def _prefix_req(rid, prefix, rng, suffix_len=4, max_new=8) -> dict:
+    suffix = rng.integers(0, 64, (suffix_len,)).astype(np.int32)
+    return {
+        "rid": rid,
+        "prompt": np.concatenate([np.asarray(prefix, np.int32), suffix]),
+        "max_new": max_new,
+    }
+
+
+def _prefix_hit_rids(events, lo: int, hi: int) -> set:
+    return {
+        int(e["rid"]) for e in events
+        if e.get("kind") == "serve_prefix_hit" and lo <= e.get("rid", -1) < hi
+    }
+
+
+class FleetManager:
+    """Binds :class:`ServeLeaseClient`'s hooks to the real fleet: a
+    grant activates a pre-warmed standby replica (its endpoint file is
+    copied from the staging dir into the live dir, where the front door
+    discovers it instantly — no boot inside the lease window); a revoke
+    SIGTERM-drains the replicas on the revoked chips and returns only
+    once they exited, so the ack that follows really means the chips are
+    free.  ``inflight`` counts front-door-outstanding requests on the
+    replicas of chips revoked-but-not-yet-drained — the drain fence's
+    evidence."""
+
+    def __init__(self, fd, ctrl, procs, *, staging=None, standby_ranks=(),
+                 chip_to_rank=None, decode_sleep=DECODE_SLEEP):
+        self.fd = fd
+        self.ctrl = ctrl
+        self.procs = procs
+        self.staging = staging
+        self.standby: deque = deque(standby_ranks)
+        self.chip_to_rank: dict = dict(chip_to_rank or {})
+        self.pending_revoke: set = set()
+        self.drain_rcs: dict = {}
+        self._next_rank = 1 + max(
+            list(procs) + list(standby_ranks), default=0
+        )
+        self._decode_sleep = decode_sleep
+
+    def note_directive(self, d) -> None:
+        """Record which replicas a directive revokes BEFORE it is
+        applied — from here until their drain completes, an ack while
+        they hold in-flight work is a protocol violation."""
+        self.pending_revoke |= {
+            self.chip_to_rank[c] for c in d.revoked
+            if c in self.chip_to_rank
+        }
+
+    def inflight(self) -> int:
+        return sum(
+            self.fd.clients[r].outstanding
+            for r in self.pending_revoke if r in self.fd.clients
+        )
+
+    def _await_standby(self, rank: int, timeout_s: float = READY_TIMEOUT_S):
+        from flextree_tpu.runtime.ctrlfile import read_control_json
+        from flextree_tpu.serving.rpc import RpcConnection, RpcError
+
+        path = os.path.join(self.staging, f"rpc_{rank:05d}.json")
+        deadline = _mono() + timeout_s
+        while _mono() < deadline:
+            ep = read_control_json(path)
+            if ep is not None:
+                try:
+                    conn = RpcConnection.connect(
+                        ep["host"], int(ep["port"]), timeout_s=1.0
+                    )
+                    try:
+                        if conn.call({"kind": "ping"}, timeout_s=2.0).get(
+                            "ok"
+                        ):
+                            return ep
+                    finally:
+                        conn.close()
+                except RpcError:
+                    pass
+            time.sleep(0.1)
+        raise TimeoutError(f"standby replica {rank} never became ready")
+
+    def on_grant(self, chips) -> None:
+        from flextree_tpu.runtime.ctrlfile import write_control_json
+
+        for c in chips:
+            rank = self.standby.popleft()
+            ep = self._await_standby(rank)
+            write_control_json(
+                self.ctrl,
+                os.path.join(self.ctrl, f"rpc_{rank:05d}.json"), ep,
+            )
+            self.chip_to_rank[c] = rank
+        self.fd.refresh()
+
+    def on_revoke(self, chips) -> None:
+        ranks = [self.chip_to_rank[c] for c in chips]
+        for r in ranks:
+            proc = self.procs[r]
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for c, r in zip(chips, ranks):
+            proc = self.procs[r]
+            try:
+                proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            self.drain_rcs[r] = proc.returncode
+            if self.staging is not None:
+                try:  # the live-dir endpoint copy is ours to retract
+                    os.unlink(
+                        os.path.join(self.ctrl, f"rpc_{r:05d}.json")
+                    )
+                except OSError:
+                    pass
+            self.fd.forget_replica(r)
+            self.pending_revoke.discard(r)
+            del self.chip_to_rank[c]
+            if self.staging is not None:
+                # replenish the standby bench so a later breach cycle
+                # can still be granted (the drained process is gone)
+                nr = self._next_rank
+                self._next_rank += 1
+                self.procs[nr] = _spawn(
+                    self.staging, nr,
+                    {"FT_RPC_DECODE_SLEEP": self._decode_sleep},
+                )
+                self.standby.append(nr)
+
+
+# --------------------------------------------------------------------------
+# scenario 1: the tentpole — SLO autoscaling over real processes
+# --------------------------------------------------------------------------
+
+
+def run_autoscale_scenario(workdir: str, oracle) -> dict:
+    from flextree_tpu.arbiter import (
+        ArbiterConfig,
+        DeviceInventory,
+        PoolArbiter,
+        file_slo_reader,
+    )
+    from flextree_tpu.planner.choose import replan_for_survivors
+    from flextree_tpu.runtime import (
+        SERVE,
+        TRAIN,
+        LeaseLedger,
+        PreemptionGuard,
+        ServeLeaseClient,
+        TrainLeaseClient,
+    )
+    from flextree_tpu.serving.workload import build_spike_workload
+
+    ctrl = os.path.join(workdir, "ctrl")
+    staging = os.path.join(workdir, "stage")
+    hb = os.path.join(workdir, "hb")
+    slo_dir = os.path.join(workdir, "slo")
+    ck = os.path.join(workdir, "ck")
+    for d in (ctrl, staging, hb, slo_dir, ck):
+        os.makedirs(d, exist_ok=True)
+
+    # ~14 rps single-replica capacity (4 slots / ~29 paced 10 ms decode
+    # rounds): the 2 rps baseline holds it comfortably, the 20 rps spike
+    # queues seconds past the SLO, and the 3-replica pooled ~40 rps
+    # drains the backlog once the grant lands
+    sleep = "0.01"
+    env = {"FT_RPC_DECODE_SLEEP": sleep}
+    procs = {0: _spawn(ctrl, 0, env)}
+    procs.update({r: _spawn(staging, r, env) for r in (1, 2)})
+
+    reqs, spike_start, spike_end = build_spike_workload(
+        seed=13, base_rate=2.0, spike_rate=20.0,
+        t_base=10.0, t_spike=12.0, t_tail=4.0, vocab=64,
+    )
+    requests = [_as_req(r) for r in reqs]
+    arrivals = {r.rid: r.arrival_s for r in reqs}
+
+    # training: pre-warmed sharded worlds for dp-3 and the shrink dp-1
+    worlds = spike.TrainWorlds(spike._train_model())
+    nbytes_hint = 1 << 20
+    plans = {
+        n: replan_for_survivors(
+            n, nbytes_hint, configured=len(TRAIN_CHIPS)
+        ).to_ft_topo()
+        for n in (len(TRAIN_CHIPS), len(TRAIN_CHIPS) - BURST_CHIPS)
+    }
+    for n, topo in plans.items():
+        worlds.warm(n, topo)
+
+    ledger = LeaseLedger(hb)
+    inventory = DeviceInventory(CHIPS, train=TRAIN_CHIPS)
+    acfg = ArbiterConfig(
+        slo_p99_ms=SLO_P99_MS, window_s=WINDOW_S, release_frac=0.5,
+        breach_ticks=2, clear_ticks=10, cooldown_s=6.0,
+        min_train_chips=1, burst_chips=BURST_CHIPS, min_samples=6,
+    )
+    arbiter = PoolArbiter(
+        inventory, ledger, acfg,
+        slo_reader=file_slo_reader(slo_dir, window_s=WINDOW_S),
+        serve_is_tenant=True,
+    )
+    tclient = TrainLeaseClient(
+        ledger, initial_chips=TRAIN_CHIPS, configured=len(TRAIN_CHIPS),
+        nbytes_hint=nbytes_hint, poll_interval_s=0.1,
+    )
+    guard = PreemptionGuard()
+    trainer, holder = spike.start_trainer(worlds, tclient, ck, guard, plans)
+
+    ledger_docs: dict = {}
+
+    def observe_ledger():
+        g = ledger.read()
+        if g is not None:
+            ledger_docs[g.epoch] = dict(g.grants)
+
+    idle = False
+    try:
+        rc._wait_ready(ctrl, [0])
+        rc._wait_ready(staging, [1, 2])
+        # dispatchers bound fleet-WIDE concurrency (each blocks on one
+        # RPC round): size them for the grown fleet, or granted replicas
+        # idle behind the dispatch pool and the grant buys nothing
+        fd = rc._frontdoor(
+            ctrl, request_timeout_s=120.0, max_attempts=20,
+            shed_outstanding=256, slo_window_s=WINDOW_S, dispatchers=16,
+        )
+        fd.start()
+        mgr = FleetManager(
+            fd, ctrl, procs, staging=staging, standby_ranks=(1, 2),
+            chip_to_rank={3: 0}, decode_sleep=sleep,
+        )
+        sclient = ServeLeaseClient(
+            ledger, on_grant=mgr.on_grant, on_revoke=mgr.on_revoke,
+            inflight=mgr.inflight, initial_chips=(3,),
+            poll_interval_s=0.1,
+        )
+
+        pending = deque(sorted(requests, key=lambda r: arrivals[r["rid"]]))
+        t0 = _mono()
+        wall0 = time.time()
+        last_tick = t0
+        deadline = t0 + 300.0
+        while _mono() < deadline:
+            now = _mono()
+            rel = now - t0
+            while pending and arrivals[pending[0]["rid"]] <= rel:
+                _submit(fd, pending.popleft())
+            if now - last_tick >= TICK_S:
+                fd.write_metrics(slo_dir)
+                arbiter.tick()
+                observe_ledger()
+                last_tick = now
+            d = sclient.poll()
+            if d is not None:
+                mgr.note_directive(d)
+                sclient.apply(d)
+            if (
+                not pending
+                and len(fd.completed) + len(fd.failed) == len(requests)
+                and not arbiter.loaned
+                and not arbiter.pending_handoff
+                and any(
+                    dd["action"] == "return" for dd in arbiter.decisions
+                )
+            ):
+                # the return handoff completed; wait for training to ack
+                # the expand epoch AND actually step in the re-expanded
+                # world (the ack alone can precede the resize being
+                # applied at the next step boundary) so the bitwise
+                # resume lands in lease_epochs before the run stops
+                grant_decisions = [
+                    dd for dd in arbiter.decisions
+                    if dd["action"] == "grant"
+                ]
+                if grant_decisions:
+                    last_grant = grant_decisions[-1]
+                    expanded_steps = sum(
+                        1 for w, _, nd in worlds.step_trace
+                        if nd == len(TRAIN_CHIPS)
+                        and w >= last_grant["wall"]
+                    )
+                    if (
+                        ledger.acked_epoch(TRAIN) >= last_grant["epoch"]
+                        and expanded_steps >= 2
+                    ):
+                        break
+            time.sleep(0.02)
+        observe_ledger()
+        guard.trigger()
+        trainer.join(timeout=120.0)
+        idle = fd.wait_idle(timeout_s=60.0)
+        counters = rc._counters(fd.metrics)
+        fd.close()
+    finally:
+        guard.trigger()
+        rcs = rc._shutdown(procs)
+
+    result = holder.get("result")
+    report = result.report if result is not None else None
+    lease_epochs = list(report.lease_epochs) if report is not None else []
+    decisions = list(arbiter.decisions)
+
+    def walls(action):
+        return [d["wall"] for d in decisions if d["action"] == action]
+
+    preempts, grants, returns = (
+        walls("preempt"), walls("grant"), walls("return")
+    )
+    spike_end_wall = wall0 + spike_end
+
+    recovery_wall = None
+    if grants:
+        for d in decisions:
+            if d["wall"] < grants[0]:
+                continue
+            p99 = d["reading"]["p99_ms"]
+            if d["reading"]["samples"] == 0 or (
+                p99 is not None and p99 <= SLO_P99_MS
+            ):
+                recovery_wall = d["wall"]
+                break
+    recovery_ref = max(grants[0], spike_end_wall) if grants else None
+    recovery_s = (
+        None if recovery_wall is None or recovery_ref is None
+        else max(0.0, recovery_wall - recovery_ref)
+    )
+
+    single_holder = {}
+    chipset = set(CHIPS)
+    for epoch, grants_doc in sorted(ledger_docs.items()):
+        seen: list = []
+        for chips in grants_doc.values():
+            seen.extend(chips)
+        single_holder[epoch] = (
+            len(seen) == len(set(seen)) and set(seen) == chipset
+        )
+
+    final = ledger.read()
+    bad = rc.bitwise_violations(fd, requests, oracle)
+    drained_rcs = dict(mgr.drain_rcs)
+    floors = {
+        "arbiter_preempted": len(preempts) >= 1,
+        "serve_granted": len(grants) >= 1,
+        "chips_returned": len(returns) >= 1
+        and final is not None
+        and final.chips(TRAIN) == tuple(TRAIN_CHIPS)
+        and final.chips(SERVE) == (3,)
+        and not arbiter.loaned
+        and not arbiter.pending_handoff,
+        "p99_recovered_within_two_windows": recovery_s is not None
+        and recovery_s <= 2 * WINDOW_S,
+        "train_resumed_bitwise": len(lease_epochs) >= 2
+        and all(e["bitwise_resume"] for e in lease_epochs)
+        and holder.get("error") is None,
+        "single_holder_every_publish": bool(single_holder)
+        and all(single_holder.values()),
+        "all_completed_exactly_once": idle
+        and sorted(fd.completed) == sorted(r["rid"] for r in requests)
+        and not fd.failed and not fd.shed_rids,
+        "bitwise_vs_generate": not bad,
+        "revoked_replicas_drained_clean": bool(drained_rcs)
+        and all(rc_ == 0 for rc_ in drained_rcs.values()),
+    }
+    return {
+        "scenario": "elastic_autoscale",
+        "injection": "open-loop Poisson burst (2 -> 20 rps) over a "
+                     "1-replica fleet; the arbiter autoscales through "
+                     "the lease ledger off metrics_fd_*.json windows",
+        "ok": all(floors.values()),
+        "floors": floors,
+        "checks": {
+            "decisions_actions": [
+                {k: d[k] for k in ("action", "epoch")}
+                for d in decisions if d["action"]
+            ],
+            "recovery_s": None if recovery_s is None else round(
+                recovery_s, 3
+            ),
+            "recovery_windows": None if recovery_s is None else round(
+                recovery_s / WINDOW_S, 3
+            ),
+            "lease_epochs": lease_epochs,
+            "trainer_error": holder.get("error"),
+            "single_holder_by_epoch": single_holder,
+            "drained_rcs": _strk(drained_rcs),
+            "rcs": _strk(rcs),
+            "counters": counters,
+            "bitwise_bad_rids": bad,
+            "failed": dict(fd.failed),
+            "completed": len(fd.completed),
+            "n_requests": len(requests),
+            "spike_window_s": [spike_start, spike_end],
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# scenario 2: prefix-warm drain handoff vs a cold successor
+# --------------------------------------------------------------------------
+
+HANDOFF_SUFFIX_WARM = "32:4"  # cached 32-token prefix, 4-token suffixes
+
+
+def run_handoff_scenario(workdir: str, oracle) -> dict:
+    from flextree_tpu.obs import read_dir
+    from flextree_tpu.runtime.ctrlfile import read_control_json
+
+    ctrl = os.path.join(workdir, "ctrl")
+    ctrl_cold = os.path.join(workdir, "ctrl_cold")
+    os.makedirs(ctrl, exist_ok=True)
+    os.makedirs(ctrl_cold, exist_ok=True)
+    handoff = os.path.join(ctrl, "handoff_00000.json")
+
+    pool = _prefix_pool(seed=71, n=3)
+    rng = np.random.default_rng(73)
+    round1 = [_prefix_req(i, pool[i], rng) for i in range(3)]
+    round2 = [_prefix_req(10 + i, pool[i], rng) for i in range(3)]
+    # ONE request per prefix in the warm round: a cold successor cannot
+    # self-warm inside the round, so first-touch hits prove the prewarm
+    warm_round = [_prefix_req(20 + i, pool[i], rng) for i in range(3)]
+    everything = round1 + round2 + warm_round
+
+    def spawn(d, rank, extra):
+        return _spawn(
+            d, rank, extra_args=("--prefix-cache", *extra),
+            warm_lens=(36,), warm_max_new=8,
+            suffix_lens=HANDOFF_SUFFIX_WARM,
+        )
+
+    procs = {0: spawn(ctrl, 0, ("--handoff-out", handoff))}
+    moved = 0
+    try:
+        rc._wait_ready(ctrl, [0])
+        fd = rc._frontdoor(ctrl)
+        fd.start()
+        for req in round1:
+            _submit(fd, req)
+        fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        for req in round2:
+            _submit(fd, req)
+        fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        procs[0].send_signal(signal.SIGTERM)  # drain -> handoff export
+        procs[0].wait(timeout=30.0)
+        a_rc = procs[0].returncode
+        exported = read_control_json(handoff)
+        procs[1] = spawn(ctrl, 1, ("--handoff-in", handoff))
+        rc._wait_ready(ctrl, [1])
+        moved = fd.reassign_affinity(0, 1)
+        fd.forget_replica(0)
+        fd.refresh()
+        for req in warm_round:
+            _submit(fd, req)
+        warm_idle = fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        counters = rc._counters(fd.metrics)
+        fd.close()
+    finally:
+        rcs = rc._shutdown(procs)
+
+    # the negative control: an identical first-touch round against a
+    # cold replica that never saw the handoff
+    cold_procs = {0: spawn(ctrl_cold, 0, ())}
+    try:
+        rc._wait_ready(ctrl_cold, [0])
+        fd_cold = rc._frontdoor(ctrl_cold)
+        fd_cold.start()
+        for req in warm_round:
+            _submit(fd_cold, req)
+        cold_idle = fd_cold.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        fd_cold.close()
+    finally:
+        cold_rcs = rc._shutdown(cold_procs)
+
+    events, _ = read_dir(ctrl)
+    cold_events, _ = read_dir(ctrl_cold)
+    a_hits = _prefix_hit_rids(events, 10, 20)
+    b_hits = _prefix_hit_rids(events, 20, 30)
+    c_hits = _prefix_hit_rids(cold_events, 20, 30)
+    a_rate = len(a_hits) / len(round2)
+    b_rate = len(b_hits) / len(warm_round)
+    c_rate = len(c_hits) / len(warm_round)
+    bad = rc.bitwise_violations(fd, everything, oracle)
+    bad_cold = rc.bitwise_violations(fd_cold, warm_round, oracle)
+    cold_started = any(
+        e.get("kind") == "serve_handoff_cold_start" for e in events
+    )
+    floors = {
+        "drainer_exported_handoff": a_rc == 0 and exported is not None
+        and len(exported.get("entries", ())) >= 1,
+        "successor_prewarmed_not_cold": not cold_started,
+        "warm_hit_rate_at_least_half_of_predecessor": a_rate > 0
+        and b_rate >= 0.5 * a_rate,
+        "cold_control_below_warm": c_rate < b_rate,
+        "affinity_followed_the_handoff": moved >= 1,
+        "all_completed_exactly_once": warm_idle and cold_idle
+        and sorted(fd.completed) == sorted(r["rid"] for r in everything)
+        and sorted(fd_cold.completed)
+        == sorted(r["rid"] for r in warm_round)
+        and not fd.failed and not fd_cold.failed,
+        "bitwise_vs_generate": not bad and not bad_cold,
+    }
+    return {
+        "scenario": "prefix_handoff_warm_vs_cold",
+        "injection": "SIGTERM drain exports the radix prefix index "
+                     "(prefix tokens + block hashes); the successor "
+                     "prewarms from it; a cold twin is the control",
+        "ok": all(floors.values()),
+        "floors": floors,
+        "checks": {
+            "predecessor_hit_rate": a_rate,
+            "warm_successor_hit_rate": b_rate,
+            "cold_control_hit_rate": c_rate,
+            "handoff_entries": (
+                len(exported.get("entries", ())) if exported else 0
+            ),
+            "affinity_moved": moved,
+            "rcs": {**_strk(rcs), "cold": _strk(cold_rcs)},
+            "counters": counters,
+            "bitwise_bad_rids": bad + bad_cold,
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# scenario 3: SIGKILL mid-handoff -> checksum-refused/absent export,
+# cold-start successor, exactly-once completion
+# --------------------------------------------------------------------------
+
+
+def run_sigkill_handoff_scenario(workdir: str, oracle) -> dict:
+    from flextree_tpu.obs import read_dir
+
+    ctrl = os.path.join(workdir, "ctrl")
+    os.makedirs(ctrl, exist_ok=True)
+    handoff = os.path.join(ctrl, "handoff_00000.json")
+
+    pool = _prefix_pool(seed=83, n=2)
+    rng = np.random.default_rng(87)
+    warm = [_prefix_req(100 + i, pool[i], rng) for i in range(2)]
+    inflight = [
+        _prefix_req(i, pool[i % 2], rng, max_new=16) for i in range(6)
+    ]
+
+    def spawn(rank, extra):
+        return _spawn(
+            ctrl, rank, {"FT_RPC_DECODE_SLEEP": "0.05"},
+            extra_args=("--prefix-cache", *extra),
+            warm_lens=(36,), warm_max_new=16,
+            suffix_lens=HANDOFF_SUFFIX_WARM,
+        )
+
+    procs = {0: spawn(0, ("--handoff-out", handoff))}
+    try:
+        rc._wait_ready(ctrl, [0])
+        fd = rc._frontdoor(
+            ctrl, request_timeout_s=240.0, max_attempts=20,
+        )
+        fd.start()
+        for req in warm:
+            _submit(fd, req)
+        fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        for req in inflight:
+            _submit(fd, req)
+        time.sleep(0.4)  # decode in flight on the drainer
+        os.kill(procs[0].pid, signal.SIGKILL)
+        procs[0].wait(timeout=10.0)
+        kill_rc = procs[0].returncode
+        handoff_absent = not os.path.exists(handoff)
+        # the successor spawns AFTER the crash; the front door's retry
+        # loop keeps the in-flight requests alive across its boot
+        procs[1] = spawn(1, ("--handoff-in", handoff))
+        idle = fd.wait_idle(timeout_s=READY_TIMEOUT_S)
+        counters = rc._counters(fd.metrics)
+        fd.close()
+    finally:
+        rcs = rc._shutdown(procs)
+
+    events, _ = read_dir(ctrl)
+    cold_starts = [
+        e for e in events if e.get("kind") == "serve_handoff_cold_start"
+    ]
+    want = warm + inflight
+    bad = rc.bitwise_violations(fd, want, oracle)
+    floors = {
+        "killed_by_sigkill": kill_rc == -signal.SIGKILL,
+        "no_partial_handoff_accepted": handoff_absent,
+        "successor_cold_started_loudly": len(cold_starts) >= 1,
+        "all_completed_exactly_once": idle
+        and sorted(fd.completed) == sorted(r["rid"] for r in want)
+        and not fd.failed,
+        "bitwise_vs_generate": not bad,
+        "zero_duplicate_results": counters.get(
+            "serve.duplicate_results", 0
+        ) == 0,
+        "successor_exited_clean": rcs.get(1) == 0,
+    }
+    return {
+        "scenario": "sigkill_mid_handoff",
+        "injection": "SIGKILL of the drain-exporting replica with "
+                     "decode in flight; successor boots against the "
+                     "absent handoff file",
+        "ok": all(floors.values()),
+        "floors": floors,
+        "checks": {
+            "rcs": _strk({**rcs, 0: kill_rc}),
+            "cold_start_events": cold_starts[:2],
+            "counters": counters,
+            "bitwise_bad_rids": bad,
+            "failed": dict(fd.failed),
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# scenario 4: arbiter restart between parking chips and the grant
+# --------------------------------------------------------------------------
+
+
+def run_arbiter_restart_scenario(workdir: str, oracle) -> dict:
+    from flextree_tpu.arbiter import (
+        ArbiterConfig,
+        DeviceInventory,
+        PoolArbiter,
+    )
+    from flextree_tpu.arbiter.core import SloReading
+    from flextree_tpu.runtime import SERVE, TRAIN, LeaseLedger
+
+    ctrl = os.path.join(workdir, "ctrl")
+    hb = os.path.join(workdir, "hb")
+    os.makedirs(ctrl, exist_ok=True)
+    os.makedirs(hb, exist_ok=True)
+
+    cfg = ArbiterConfig(
+        slo_p99_ms=100.0, window_s=WINDOW_S, breach_ticks=2,
+        clear_ticks=999, cooldown_s=0.0, min_train_chips=1,
+        burst_chips=1, min_samples=1,
+    )
+    ledger = LeaseLedger(hb)
+    docs: dict = {}
+
+    def observe():
+        g = ledger.read()
+        if g is not None:
+            docs[g.epoch] = dict(g.grants)
+
+    breach = lambda: SloReading(p99_ms=5000.0, samples=50)  # noqa: E731
+    quiet = lambda: SloReading(p99_ms=0.0, samples=0)  # noqa: E731
+
+    arb1 = PoolArbiter(
+        DeviceInventory((0, 1), train=(0, 1)), ledger, cfg,
+        slo_reader=breach, serve_is_tenant=True,
+    )
+    observe()
+    arb1.tick()
+    parked = arb1.tick()  # breach streak 2 -> preempt, chips parked
+    observe()
+    pending_before = tuple(arb1.pending_handoff)
+    del arb1  # the crash: pending handoff survives only on disk
+
+    # training acks the park (its client would; here the scenario is the
+    # arbiter's, so the ack is direct)
+    g = ledger.read()
+    ledger.ack(TRAIN, g.epoch)
+
+    granted_chips: list = []
+    procs: dict = {}
+
+    def on_serve_grant(chips):
+        granted_chips.extend(chips)
+        procs[0] = _spawn(ctrl, 0, warm_max_new=16)
+
+    inv2 = DeviceInventory.from_grants(ledger.read().grants)
+    arb2 = PoolArbiter(
+        inv2, ledger, cfg, slo_reader=quiet,
+        on_serve_grant=on_serve_grant, serve_is_tenant=True,
+    )
+    observe()
+    resumed = tuple(arb2.pending_handoff)
+    granted = arb2.tick()  # completes the resumed handoff
+    observe()
+
+    requests = rc.build_requests(seed=41, n=3)
+    try:
+        if procs:
+            rc._wait_ready(ctrl, [0])
+            fd = rc._frontdoor(ctrl)
+            fd.start()
+            for req in requests:
+                _submit(fd, req)
+            idle = fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+            fd.close()
+        else:
+            idle = False
+    finally:
+        rcs = rc._shutdown(procs)
+
+    chipset = {0, 1}
+    single_holder = {
+        e: (lambda seen: len(seen) == len(set(seen))
+            and set(seen) == chipset)(
+            [c for chips in gr.values() for c in chips]
+        )
+        for e, gr in sorted(docs.items())
+    }
+    final = ledger.read()
+    bad = rc.bitwise_violations(fd, requests, oracle) if procs else []
+    floors = {
+        "preempt_parked_before_crash": parked["action"] == "preempt"
+        and pending_before == (1,),
+        "pending_handoff_resumed_from_disk": bool(resumed)
+        and resumed == pending_before,
+        "grant_completed_after_restart": granted["action"] == "grant"
+        and granted_chips == [1],
+        "chip_landed_on_serve": final is not None
+        and final.chips(SERVE) == (1,) and final.chips(TRAIN) == (0,),
+        "single_holder_every_publish": bool(single_holder)
+        and all(single_holder.values()),
+        "served_on_granted_chip": idle
+        and sorted(fd.completed) == [r["rid"] for r in requests]
+        and not fd.failed,
+        "bitwise_vs_generate": not bad,
+        "replica_exited_clean": rcs.get(0) == 0,
+    }
+    return {
+        "scenario": "arbiter_restart_mid_grant",
+        "injection": "arbiter process dropped between the preempt "
+                     "publish (chips parked on ARBITER) and the grant; "
+                     "restart rebuilds inventory from the ledger and "
+                     "resumes arbiter_state.json",
+        "ok": all(floors.values()),
+        "floors": floors,
+        "checks": {
+            "single_holder_by_epoch": single_holder,
+            "pending_before": list(pending_before),
+            "resumed": list(resumed),
+            "rcs": _strk(rcs),
+            "bitwise_bad_rids": bad,
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# scenario 5: a revoke racing live traffic — the drain fence
+# --------------------------------------------------------------------------
+
+
+def run_drain_race_scenario(workdir: str, oracle) -> dict:
+    from flextree_tpu.runtime import (
+        ARBITER,
+        SERVE,
+        LeaseLedger,
+        ServeLeaseClient,
+    )
+    from flextree_tpu.runtime.coordination import ProtocolViolation
+
+    ctrl = os.path.join(workdir, "ctrl")
+    hb = os.path.join(workdir, "hb")
+    os.makedirs(ctrl, exist_ok=True)
+    os.makedirs(hb, exist_ok=True)
+
+    procs = {
+        r: _spawn(ctrl, r, {"FT_RPC_DECODE_SLEEP": "0.05"})
+        for r in range(2)
+    }
+    requests = rc.build_requests(seed=53, n=8)
+    ledger = LeaseLedger(hb)
+    ledger.publish(1, {SERVE: (0, 1)}, reason="baseline")
+    violation = None
+    premature_ack_epoch = None
+    try:
+        rc._wait_ready(ctrl, procs)
+        fd = rc._frontdoor(ctrl)
+        fd.start()
+        mgr = FleetManager(
+            fd, ctrl, procs, chip_to_rank={0: 0, 1: 1},
+        )
+        sclient = ServeLeaseClient(
+            ledger, on_revoke=mgr.on_revoke, inflight=mgr.inflight,
+            initial_chips=(0, 1), poll_interval_s=0.0,
+        )
+        assert sclient.poll() is None  # epoch 1 matches: acked in place
+        for req in requests:
+            _submit(fd, req)
+        time.sleep(0.4)  # in flight on BOTH replicas
+        ledger.publish(2, {SERVE: (1,), ARBITER: (0,)}, reason="revoke")
+        d = sclient.poll()
+        mgr.note_directive(d)
+        inflight_at_revoke = mgr.inflight()
+        try:
+            sclient.ack(d)  # the race: ack while requests are in flight
+        except ProtocolViolation as e:
+            violation = str(e)
+        premature_ack_epoch = ledger.acked_epoch(SERVE)
+        sclient.apply(d)  # the correct path: drain rank 0, THEN ack
+        acked_after = ledger.acked_epoch(SERVE)
+        idle = fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        counters = rc._counters(fd.metrics)
+        fd.close()
+        drain_rc = mgr.drain_rcs.get(0)
+    finally:
+        rcs = rc._shutdown(procs)
+
+    bad = rc.bitwise_violations(fd, requests, oracle)
+    floors = {
+        "revoke_raced_live_traffic": inflight_at_revoke >= 1,
+        "early_ack_refused_loudly": violation is not None
+        and "still in flight" in violation,
+        "no_ack_written_by_refusal": premature_ack_epoch == 1,
+        "drain_then_ack_succeeded": acked_after == 2 and drain_rc == 0,
+        "all_completed_exactly_once": idle
+        and sorted(fd.completed) == [r["rid"] for r in requests]
+        and not fd.failed,
+        "bitwise_vs_generate": not bad,
+        "zero_duplicate_results": counters.get(
+            "serve.duplicate_results", 0
+        ) == 0,
+    }
+    return {
+        "scenario": "drain_races_revoke",
+        "injection": "revoke published while the revoked replica holds "
+                     "in-flight decode; ack attempted before the drain",
+        "ok": all(floors.values()),
+        "floors": floors,
+        "checks": {
+            "inflight_at_revoke": inflight_at_revoke,
+            "violation": violation,
+            "acked_epoch_after_refusal": premature_ack_epoch,
+            "acked_epoch_after_drain": acked_after,
+            "rcs": _strk({**rcs, 0: drain_rc}),
+            "counters": counters,
+            "bitwise_bad_rids": bad,
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# scenario 6: prefix-aware miss-first shedding A/B under overload
+# --------------------------------------------------------------------------
+
+
+def _shed_run(workdir, tag, oracle, warm, burst, gaps, **shed_cfg):
+    ctrl = os.path.join(workdir, f"ctrl_{tag}")
+    os.makedirs(ctrl, exist_ok=True)
+    procs = {
+        0: _spawn(
+            ctrl, 0, {"FT_RPC_DECODE_SLEEP": DECODE_SLEEP},
+            extra_args=("--prefix-cache",),
+            warm_lens=(6, 36), warm_max_new=16,
+            suffix_lens=HANDOFF_SUFFIX_WARM,
+        )
+    }
+    try:
+        rc._wait_ready(ctrl, [0])
+        fd = rc._frontdoor(ctrl, **shed_cfg)
+        fd.start()
+        for req in warm:  # seed the prefix index AND the affinity table
+            _submit(fd, req)
+        fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        t0 = _mono()
+        for req, gap in zip(burst, gaps):
+            time.sleep(float(gap))
+            _submit(fd, req)
+        idle = fd.wait_idle(timeout_s=rc.RUN_TIMEOUT_S)
+        wall_s = _mono() - t0
+        counters = rc._counters(fd.metrics)
+        fd.close()
+    finally:
+        rcs = rc._shutdown(procs)
+    by_rid = {r["rid"]: r for r in burst}
+    done = {rid for rid in fd.completed if rid in by_rid}
+    shed = set(fd.shed_rids)
+    failed = set(fd.failed)
+    tokens = sum(
+        len(by_rid[rid]["prompt"]) + by_rid[rid]["max_new"] for rid in done
+    )
+    return {
+        "fd": fd,
+        "idle": idle,
+        "rcs": rcs,
+        "counters": counters,
+        "completed": len(done),
+        "shed": len(shed),
+        "tokens_per_s": tokens / wall_s if wall_s > 0 else 0.0,
+        "accounted": (
+            not (done & shed) and not (done & failed)
+            and not (shed & failed) and not failed
+            and done | shed == set(by_rid)
+        ),
+        "bad": rc.bitwise_violations(fd, warm + burst, oracle),
+    }
+
+
+def run_shed_scenario(workdir: str, oracle) -> dict:
+    pool = _prefix_pool(seed=91, n=2)
+    rng = np.random.default_rng(97)
+    warm = [_prefix_req(1000 + i, pool[i], rng, max_new=16)
+            for i in range(2)]
+    burst = []
+    for i in range(60):
+        if i % 2 == 0:  # a predicted prefix HIT: long shared prompt
+            burst.append(_prefix_req(i, pool[(i // 2) % 2], rng,
+                                     max_new=16))
+        else:  # a miss: short unshared prompt
+            burst.append({
+                "rid": i,
+                "prompt": rng.integers(0, 64, (6,)).astype(np.int32),
+                "max_new": 16,
+            })
+    gaps = np.random.default_rng(101).exponential(1.0 / 30.0, size=60)
+
+    # SAME total inflight cap (8) on both sides: A reserves the upper
+    # half for predicted hits, B spends it on whoever arrives first
+    a = _shed_run(workdir, "miss_first", oracle, warm, burst, gaps,
+                  shed_outstanding=4, shed_hit_headroom=4)
+    b = _shed_run(workdir, "flat", oracle, warm, burst, gaps,
+                  shed_outstanding=8, shed_hit_headroom=0)
+    floors = {
+        "both_overloaded_and_shed": a["shed"] >= 1 and b["shed"] >= 1,
+        "miss_first_sheds_accounted": a["counters"].get(
+            "serve.shed_miss_first", 0
+        ) >= 1,
+        "flat_policy_never_miss_first": b["counters"].get(
+            "serve.shed_miss_first", 0
+        ) == 0,
+        "miss_first_beats_flat_token_throughput": (
+            a["tokens_per_s"] > b["tokens_per_s"]
+        ),
+        "every_rid_accounted_once": a["accounted"] and b["accounted"]
+        and a["idle"] and b["idle"],
+        "bitwise_vs_generate": not a["bad"] and not b["bad"],
+    }
+    return {
+        "scenario": "shed_miss_first_load",
+        "injection": "~30 rps open-loop burst (50% shared-prefix hits, "
+                     "50% misses) into one ~12 rps replica; "
+                     "shed_outstanding=4+headroom=4 vs 8+0 — the same "
+                     "max-inflight cap",
+        "ok": all(floors.values()),
+        "floors": floors,
+        "checks": {
+            side: {
+                "tokens_per_s": round(run["tokens_per_s"], 1),
+                "completed": run["completed"],
+                "shed": run["shed"],
+                "shed_miss_first": run["counters"].get(
+                    "serve.shed_miss_first", 0
+                ),
+                "rcs": _strk(run["rcs"]),
+                "bitwise_bad_rids": run["bad"],
+            }
+            for side, run in (("miss_first", a), ("flat", b))
+        },
+    }
+
+
+# --------------------------------------------------------------------------
+# main
+# --------------------------------------------------------------------------
+
+SCENARIOS = {
+    "autoscale": run_autoscale_scenario,
+    "handoff": run_handoff_scenario,
+    "sigkill_handoff": run_sigkill_handoff_scenario,
+    "arbiter_restart": run_arbiter_restart_scenario,
+    "drain_race": run_drain_race_scenario,
+    "shed_miss_first": run_shed_scenario,
+}
+# CI subset: the three kill-chaos protocol scenarios (no trainer, no
+# multi-minute SLO phases) — the full matrix backs the committed artifact
+SMOKE = ["sigkill_handoff", "arbiter_restart", "drain_race"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI subset: sigkill_handoff + arbiter_restart "
+                         "+ drain_race")
+    ap.add_argument("--only", default="",
+                    help="comma-separated scenario subset (debugging)")
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "SERVE_ELASTIC.json"))
+    ap.add_argument("--no-artifact", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.only:
+        names = [n for n in args.only.split(",") if n]
+    else:
+        names = SMOKE if args.smoke else list(SCENARIOS)
+    print("building the generate oracle (single-process greedy)...",
+          flush=True)
+    oracle = rc.Oracle()
+    results = []
+    with tempfile.TemporaryDirectory(prefix="ft_serve_elastic_") as wd:
+        for name in names:
+            sub = os.path.join(wd, name)
+            os.makedirs(sub, exist_ok=True)
+            print(f"=== scenario {name} ===", flush=True)
+            try:
+                res = SCENARIOS[name](sub, oracle)
+            except Exception as e:  # a crashed scenario is a failed floor
+                import traceback
+
+                traceback.print_exc()
+                res = {
+                    "scenario": name, "ok": False,
+                    "error": f"{type(e).__name__}: {e}", "floors": {},
+                }
+            print(
+                f"scenario {res['scenario']}: "
+                f"{'OK' if res['ok'] else 'FAILED'} "
+                + json.dumps(res.get("floors", {})),
+                flush=True,
+            )
+            results.append(res)
+
+    ok = all(r["ok"] for r in results)
+    if not args.no_artifact:
+        from flextree_tpu.utils.buildstamp import artifact_meta
+        from flextree_tpu.utils.logging import write_result_file
+
+        write_result_file(
+            args.out,
+            {
+                "description": "Executed elastic-serving-tenancy chaos: "
+                               "real replica processes leased chips "
+                               "through the epoch-numbered ledger "
+                               "(ServeLeaseClient), autoscaled by the "
+                               "arbiter off cross-process windowed "
+                               "metrics files, with prefix-warm drain "
+                               "handoffs — SIGKILL mid-handoff, arbiter "
+                               "restart mid-grant, a revoke racing live "
+                               "decode, an SLO autoscale round trip "
+                               "(preempt/grant/return, bitwise training "
+                               "resume), a warm-vs-cold handoff A/B, and "
+                               "a miss-first shedding A/B; exactly-once "
+                               "results bitwise vs the single-process "
+                               "generate oracle, one holder per chip in "
+                               "every observed publish, non-zero exit on "
+                               "any violation; see docs/ARBITER.md and "
+                               "docs/FAILURE_MODEL.md",
+                "build": artifact_meta(),
+                "ok": ok,
+                "smoke": args.smoke,
+                "model": "v64_d32_h2_L1_ff64_f32 (seed 0, deterministic "
+                         "cross-process)",
+                "scenarios": {r["scenario"]: r for r in results},
+            },
+        )
+        print(f"wrote {args.out} (ok={ok})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
