@@ -1196,6 +1196,59 @@ def run_serve_elastic_tripwire(timeout_s: int = 900) -> dict:
             pass
 
 
+def run_disagg_tripwire(timeout_s: int = 900) -> dict:
+    """Supplementary keys ``disagg_migration_violations`` — prefill/
+    decode disaggregation exercised end-to-end on this exact tree
+    (ISSUE 20; 0 = every prompt past the planner's crossover prefills on
+    a prefill replica, ships its KV over CRC-trailered frames to a
+    decode replica, and completes bitwise vs the single-process
+    ``generate`` oracle on BOTH codecs, int8 behind its error-bound +
+    token-identity gates) — and ``disagg_decode_p99_ratio``
+    (informational: disagg / colocated decode p99 inter-token latency at
+    equal chips; the enforced <= 0.9x floor lives in the full run
+    committed as BENCH_DISAGG.json, because CI-host latency is noise but
+    correctness is not).
+
+    Runs ``tools/bench_disagg.py --smoke`` in a subprocess (real replica
+    processes behind real TCP); a driver that fails to run reports
+    ``disagg_error`` with the keys absent — absent reads as "not
+    verified", never as "clean".
+    """
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        report_path = tf.name
+    try:
+        p = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "bench_disagg.py"),
+                "--smoke", "--out", report_path,
+            ],
+            capture_output=True, text=True, cwd=REPO, timeout=timeout_s,
+        )
+        with open(report_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        violations = sum(
+            0 if s.get("ok") else 1 for s in doc["scenarios"].values()
+        )
+        out = {"disagg_migration_violations": violations}
+        perf = doc["scenarios"].get("disagg_vs_colocated", {})
+        ratio = perf.get("checks", {}).get("decode_p99_ratio")
+        if ratio is not None:
+            out["disagg_decode_p99_ratio"] = ratio
+        if p.returncode != 0 and not violations:
+            out["disagg_error"] = f"bench_disagg rc={p.returncode}"
+        return out
+    except (subprocess.SubprocessError, OSError, ValueError, KeyError) as e:
+        return {"disagg_error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        try:
+            os.unlink(report_path)
+        except OSError:
+            pass
+
+
 def run_runtime_report_tripwire(timeout_s: int = 120) -> dict:
     """Supplementary key ``runtime_recovery_violations`` — mirrors
     ``analysis_violations``: a tiny supervised recovery exercise (one
@@ -1275,6 +1328,7 @@ def main() -> int:
         result.update(run_coordination_tripwire())
         result.update(run_rpc_chaos_tripwire())
         result.update(run_serve_elastic_tripwire())
+        result.update(run_disagg_tripwire())
         result.update(collect_prefix_tripwire(prefix_handle))
     print(json.dumps(result))
     return 0

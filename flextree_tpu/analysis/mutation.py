@@ -48,6 +48,10 @@ serve-ack-before-drain  4       ``dual-holder-use`` (serving acks a
                                 serving is actively using)
 replay-miss             4       ``completed-rid-reexecuted`` (idempotency
                                 store misses on replay)
+migration-skip-release  4       ``migration-block-leak`` (failed KV
+                                handoffs skip ``release_exported`` —
+                                every abort leaks the prefill-side
+                                blocks)
 lock-order-inversion    5       ``lock-order`` (ABBA cycle)
 dropped-guard           5       ``guard`` (guarded field written bare)
 signal-path-blocking    5       ``signal-blocking`` (handler reaches a
@@ -325,6 +329,16 @@ def _mutate_replay_miss():
     return vs
 
 
+def _mutate_skip_release():
+    from ..serving.rpc_model import MigrationModel
+    from .protocol_check import run_protocol_check
+
+    vs, _ = run_protocol_check(
+        models=[MigrationModel(mutation="skip_release")]
+    )
+    return vs
+
+
 # ----------------------------------------------------- layer 5 mutations
 
 _LOCK_ORDER_MUTANT = '''
@@ -430,6 +444,9 @@ MUTATIONS = {
     ),
     "replay-miss": (
         "completed-rid-reexecuted", "protocol", _mutate_replay_miss,
+    ),
+    "migration-skip-release": (
+        "migration-block-leak", "protocol", _mutate_skip_release,
     ),
     "lock-order-inversion": (
         "lock-order", "concurrency", _mutate_concurrency(_LOCK_ORDER_MUTANT),

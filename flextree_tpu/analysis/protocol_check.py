@@ -14,7 +14,12 @@ transition:
 - :class:`~flextree_tpu.runtime.lease_model.LeaseModel` — the
   revoke→ack→grant chip handoff with tenant restart mid-handoff;
 - :class:`~flextree_tpu.serving.rpc_model.RpcModel` — one rid's
-  retry/hedge/re-route lifecycle against the replica idempotency store.
+  retry/hedge/re-route lifecycle against the replica idempotency store;
+- :class:`~flextree_tpu.serving.rpc_model.MigrationModel` — the
+  disaggregated KV-migration handshake (export → ship → admit-or-refuse
+  → release) with the decode replica crashing at every phase: a crash
+  mid-migration never loses the request or leaks the prefill-side
+  export.
 
 Invariants checked in EVERY reachable state (write-time rules, per-state
 predicates, and quiescence checks): at most one commit per control
@@ -148,10 +153,10 @@ def _witness(parent, state, extra=None, cap: int = 24) -> str:
 def default_models():
     """The committed matrix: coordination at every small-world width
     (crash injected at every transition of each), one lease world, one
-    RPC world."""
+    RPC world, one KV-migration world."""
     from ..runtime.coord_model import CoordModel
     from ..runtime.lease_model import LeaseModel
-    from ..serving.rpc_model import RpcModel
+    from ..serving.rpc_model import MigrationModel, RpcModel
 
     return [
         CoordModel(2),
@@ -159,6 +164,7 @@ def default_models():
         CoordModel(4),
         LeaseModel(),
         RpcModel(),
+        MigrationModel(),
     ]
 
 

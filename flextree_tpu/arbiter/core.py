@@ -116,7 +116,15 @@ class ArbiterConfig:
     handoff granularity.  ``min_samples``: windows thinner than this are
     "no evidence" — never a breach.  ``admit_blocked_delta`` (optional):
     additionally breach when the pool's admit-blocked count grew by at
-    least this much since the previous tick."""
+    least this much since the previous tick.
+
+    ``min_serve_prefill_chips`` / ``min_serve_decode_chips`` floor the
+    DISAGGREGATED serving fleet per role: a scale-down never reclaims a
+    chip whose departure would strand one role at (or below) its floor —
+    a fleet with prefill replicas but zero decode replicas serves
+    nothing, and the SLO reader cannot see that until the next breach.
+    They only bind when the arbiter is built with ``serve_role_of`` (the
+    chip → role map); 0 restores role-blind reclaim."""
 
     slo_p99_ms: float
     window_s: float = 10.0  # = ServingEngine's slo_window_s default
@@ -128,6 +136,8 @@ class ArbiterConfig:
     burst_chips: int = 2
     min_samples: int = 5
     admit_blocked_delta: float | None = None
+    min_serve_prefill_chips: int = 0
+    min_serve_decode_chips: int = 0
 
     def __post_init__(self):
         if self.slo_p99_ms <= 0:
@@ -141,6 +151,10 @@ class ArbiterConfig:
             raise ValueError("min_train_chips must be >= 1")
         if self.burst_chips < 1:
             raise ValueError("burst_chips must be >= 1")
+        if self.min_serve_prefill_chips < 0:
+            raise ValueError("min_serve_prefill_chips must be >= 0")
+        if self.min_serve_decode_chips < 0:
+            raise ValueError("min_serve_decode_chips must be >= 0")
 
 
 def pool_slo_reader(pool, q: float = 99.0, *, window_s: float | None = None):
@@ -263,6 +277,7 @@ class PoolArbiter:
         on_serve_grant=None,
         on_serve_return=None,
         serve_is_tenant: bool = False,
+        serve_role_of=None,
     ):
         self.inventory = inventory
         self.ledger = ledger
@@ -270,6 +285,10 @@ class PoolArbiter:
         self.slo_reader = slo_reader
         self.on_serve_grant = on_serve_grant
         self.on_serve_return = on_serve_return
+        # chip -> serving role ("prefill" / "decode" / "both"): the map
+        # the per-role tenancy floors consult on scale-down.  None means
+        # a colocated fleet — the floors never bind.
+        self.serve_role_of = serve_role_of
         # serving as a LEDGER TENANT: scale-down is a revoke → drain →
         # ack → grant-back handshake through the ledger (the serving
         # fleet's ServeLeaseClient drains real replica processes and acks
@@ -549,14 +568,61 @@ class PoolArbiter:
         )
         return "grant" if dst == SERVE else "return"
 
+    def _reclaimable(self) -> tuple:
+        """Split the loaned chips into (take, withheld) under the
+        per-role tenancy floors: a chip stays with serving when
+        reclaiming it would drop its role's serve-chip count below
+        ``min_serve_{prefill,decode}_chips``.  Chips mapping to
+        ``"both"`` (or any role without a floor) reclaim freely; with no
+        ``serve_role_of`` map or all-zero floors the split is the old
+        role-blind take-everything."""
+        chips = tuple(self._loaned)
+        floors = {
+            "prefill": self.cfg.min_serve_prefill_chips,
+            "decode": self.cfg.min_serve_decode_chips,
+        }
+        if self.serve_role_of is None or not any(floors.values()):
+            return chips, ()
+        counts: dict = {}
+        for c in self.inventory.held_by(SERVE):
+            role = self.serve_role_of(c)
+            counts[role] = counts.get(role, 0) + 1
+        take, withheld = [], []
+        for c in chips:
+            role = self.serve_role_of(c)
+            if counts.get(role, 0) - 1 < floors.get(role, 0):
+                withheld.append(c)
+                continue
+            counts[role] = counts.get(role, 0) - 1
+            take.append(c)
+        return tuple(take), tuple(withheld)
+
     def _return(self, reading: SloReading, now: float):
         """Scale-down.  Tenant mode: phase 1 of the reverse handoff —
         revoke the loaned chips from serving (park them), publish, and
         wait for serving's ack (its lease client SIGTERM-drains the
         replica processes and refuses to ack while requests are in
         flight).  Legacy in-process mode: drain synchronously via
-        ``on_serve_return`` and move the chips in one tick."""
-        chips = tuple(self._loaned)
+        ``on_serve_return`` and move the chips in one tick.  Either way
+        the per-role tenancy floors filter the reclaim first: a chip
+        whose departure would strand prefill or decode below its floor
+        stays loaned (loudly — ``lease_withheld``), so a burst that
+        scaled up one role can never drain the other to zero."""
+        chips, withheld = self._reclaimable()
+        if withheld:
+            record_event(
+                "lease_withheld",
+                chips=list(withheld),
+                reason="role_floor",
+                **reading.to_payload(),
+            )
+            log.warning(
+                "arbiter: scale-down withholds chips %s — reclaiming "
+                "them would strand a serving role below its tenancy "
+                "floor", list(withheld),
+            )
+        if not chips:
+            return None  # everything loaned is floor-pinned: hold
         p99_txt = (
             "-" if math.isnan(reading.p99_ms) else round(reading.p99_ms, 1)
         )
@@ -587,7 +653,7 @@ class PoolArbiter:
         if self.on_serve_return is not None:
             self.on_serve_return(chips)
         self.inventory.move(chips, SERVE, TRAIN)
-        self._loaned.clear()
+        self._loaned = [c for c in self._loaned if c not in chips]
         epoch = self._publish(
             f"burst drained: p99 {p99_txt}"
             f"ms inside {self.cfg.release_frac:.0%} of SLO"

@@ -165,6 +165,20 @@ class Codec:
         q, s = encode_int8(v, step, salt=salt, block=self.block)
         return decode_int8(q, s, v.shape[0], block=self.block).reshape(shape).astype(x.dtype)
 
+    def wire_bytes(self, n_elems: int) -> int:
+        """Exact payload bytes this codec puts on the wire for ``n_elems``
+        f32 input elements — int8 pads to the codec block and ships one
+        f32 scale per block, so this is what ``wire_ratio`` approximates
+        (they converge as ``n_elems`` grows).  The serving migration
+        planner prices ship-vs-recompute from this."""
+        n = max(int(n_elems), 0)
+        if self.name == "f32":
+            return 4 * n
+        if self.name == "bf16":
+            return 2 * n
+        blocks = -(-n // self.block) if n else 0
+        return blocks * self.block + 4 * blocks
+
     def hops_for(self, n: int, widths, lonely: int = 0) -> int:
         """Encode events on the accumulation path of one allreduce: each
         phase-1 stage re-encodes partial sums, phase 2 encodes once and

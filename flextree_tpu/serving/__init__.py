@@ -33,6 +33,13 @@ benchmarked in throughput and latency percentiles instead of step time:
   hedging, circuit breakers, and load shedding — exactly-once results
   via replica-side idempotency, proven under kill chaos by
   ``tools/rpc_chaos.py`` → ``RPC_CHAOS.json``.
+- :mod:`.migration` / :mod:`.costs` — prefill/decode disaggregation:
+  replicas run as ``--role prefill`` (prompt forward only, KV shipped
+  out) or ``--role decode`` (admit migrated blocks mid-stream), the KV
+  payload rides the frame protocol as int8/f32 block-scaled tensors
+  with per-tensor CRCs, and the cost planner's migration-vs-recompute
+  crossover decides per request whether the hop pays — proven by
+  ``tools/bench_disagg.py`` → ``BENCH_DISAGG.json``.
 
 Measured artifact: ``tools/bench_serving.py`` → ``BENCH_SERVING.json``
 (open-loop Poisson load; machine-checked floors).  Design notes and the
@@ -65,6 +72,12 @@ from .kv_cache import (
     write_prefill,
     write_prefill_at,
     write_swapped,
+)
+from .migration import (
+    MigrationError,
+    migration_error_bound,
+    pack_kv,
+    unpack_kv,
 )
 from .pool import PoolConfig, ReplicaFailed, ReplicaPool
 from .prefix_index import PrefixIndex, PrefixIndexError
@@ -114,4 +127,8 @@ __all__ = [
     "FrontDoorConfig",
     "FrontDoorResult",
     "ReplicaClient",
+    "MigrationError",
+    "pack_kv",
+    "unpack_kv",
+    "migration_error_bound",
 ]

@@ -124,6 +124,11 @@ class SeqState:
     cached_tokens: int = 0
     shared_blocks: int = 0
     cow_src: int | None = None
+    # one ``_now`` stamp per emitted token (first token included): the
+    # inter-token latency distribution is ``diff(token_times)`` — the
+    # decode-SLO quantity the disagg bench holds p99 floors against,
+    # which the mean ``per_token_s`` on CompletedRequest cannot carry
+    token_times: list = dataclasses.field(default_factory=list)
 
     @property
     def rid(self) -> int:
@@ -361,6 +366,47 @@ class ContinuousBatcher:
             admitted.append((slot, state))
         return admitted
 
+    def admit_migrated(self, request: Request, first_token: int,
+                       now_s: float = 0.0):
+        """Admit a sequence whose PREFILL ran on another replica: its KV
+        blocks arrive over the wire, its first token is already emitted.
+
+        Mirrors ``try_admit``'s discipline — resume-first (a migrated
+        arrival must not take the blocks a half-done preempted sequence
+        is waiting for), all-or-nothing allocation, ``admit_blocked`` set
+        on block pressure — but skips the queue: migration is an
+        admit-or-refuse handshake, so a sequence that cannot land NOW is
+        refused back to the prefill side rather than parked.  Returns
+        ``(slot_idx, SeqState)`` with the state resident (length =
+        prompt_len, first token recorded) and the block ids ready for
+        the engine's import scatter, or ``None`` to refuse.  The caller
+        still owes the decode-token recording from the next step on."""
+        if self.preempted:
+            return None
+        free_slots = [i for i, s in enumerate(self.slots) if s is None]
+        if not free_slots:
+            return None
+        try:
+            blocks = self._alloc_with_evict(self.blocks_needed(request))
+        except CacheExhausted as e:
+            self.admit_blocked = (request.rid, e.want, e.free)
+            return None
+        state = SeqState(
+            request=request,
+            block_ids=blocks,
+            length=request.prompt_len,
+            pending_token=int(first_token),
+            generated=[int(first_token)],
+            admitted_s=now_s,
+            first_token_s=now_s,
+            admit_seq=self._next_admit_seq(),
+        )
+        state.token_times.append(now_s)
+        self._maybe_finish(state, now_s)
+        slot = free_slots[0]
+        self.slots[slot] = state
+        return slot, state
+
     # ---- on-demand growth / preemption / resume ----------------------------
 
     def blocks_for_resume(self, state: SeqState) -> int:
@@ -484,6 +530,7 @@ class ContinuousBatcher:
         s.pending_token = int(token)
         s.generated.append(int(token))
         s.first_token_s = now_s
+        s.token_times.append(now_s)
         self._maybe_finish(s, now_s)
 
     def record_decode_token(self, slot: int, token: int, now_s: float) -> None:
@@ -493,6 +540,7 @@ class ContinuousBatcher:
         s.length += 1
         s.pending_token = int(token)
         s.generated.append(int(token))
+        s.token_times.append(now_s)
         self._maybe_finish(s, now_s)
 
     def _maybe_finish(self, s: SeqState, now_s: float) -> None:

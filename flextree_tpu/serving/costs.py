@@ -28,6 +28,10 @@ __all__ = [
     "decode_round_bytes",
     "predict_decode_round_us",
     "predict_prefill_us",
+    "kv_migration_elems",
+    "predict_migration_us",
+    "plan_migration",
+    "migration_crossover_tokens",
 ]
 
 
@@ -115,3 +119,80 @@ def predict_prefill_us(cfg, prompt_len: int, params=None,
     attn = 2.0 * (t * t - c * c) * cfg.d_model * cfg.n_layers
     gflops = max(resolve_bwd_GFLOPs(params), 1e-6)
     return (dense + attn) / (gflops * 1e3)
+
+
+def kv_migration_elems(cfg, pcfg, prompt_len: int) -> int:
+    """f32 elements per K-or-V tensor of one migrated sequence: the block
+    footprint of the prompt (``blocks_for``, whole blocks — migration
+    ships the tail block too) × block positions × heads × head_dim.  One
+    sequence ships ``2 * n_layers`` such tensors."""
+    n_blocks = pcfg.blocks_for(max(int(prompt_len), 1))
+    return n_blocks * pcfg.block_size * cfg.n_heads * cfg.head_dim
+
+
+def predict_migration_us(cfg, pcfg, prompt_len: int, codec="f32",
+                         params=None) -> dict:
+    """Predicted time to ship one sequence's KV to a decode replica: the
+    α–β wire term (DCN latency + codec wire bytes over DCN bandwidth)
+    plus, for lossy codecs, the encode+decode pass over the f32 payload
+    at the calibrated codec throughput.  Returns ``{"predicted_us",
+    "wire_us", "codec_us", "bytes_on_wire"}`` — the same per-term
+    decomposition style as :func:`predict_decode_round_us`, so migration
+    residuals stay phase-attributable."""
+    from ..ops.quantize import get_codec
+    from ..planner.calibrate import default_params
+
+    if params is None:
+        params = default_params()
+    c = get_codec(codec)
+    elems = kv_migration_elems(cfg, pcfg, prompt_len)
+    n_tensors = 2 * cfg.n_layers
+    bytes_on_wire = n_tensors * c.wire_bytes(elems)
+    wire_us = params.dcn.latency_us + bytes_on_wire / (
+        max(params.dcn.bandwidth_GBps, 1e-6) * 1e3
+    )
+    codec_us = 0.0
+    if c.hop_cost:
+        codec_us = 2.0 * (n_tensors * elems * 4) / (
+            max(params.codec_bw_GBps, 1e-6) * 1e3
+        )
+    return {
+        "predicted_us": wire_us + codec_us,
+        "wire_us": wire_us,
+        "codec_us": codec_us,
+        "bytes_on_wire": bytes_on_wire,
+    }
+
+
+def plan_migration(cfg, pcfg, prompt_len: int, codec="f32",
+                   params=None) -> dict:
+    """The migrate-vs-local decision for one request: ship the quantized
+    KV (``predict_migration_us``) or recompute the prefill on the decode
+    replica (``predict_prefill_us``)?  Prefill FLOPs grow quadratically
+    in the prompt while the wire term grows linearly, so short prompts
+    recompute (never pay the hop) and long prompts ship.  Returns
+    ``{"migrate", "migrate_us", "recompute_us", "bytes_on_wire"}``."""
+    mig = predict_migration_us(cfg, pcfg, prompt_len, codec, params)
+    recompute_us = predict_prefill_us(cfg, prompt_len, params)
+    return {
+        "migrate": mig["predicted_us"] < recompute_us,
+        "migrate_us": mig["predicted_us"],
+        "recompute_us": recompute_us,
+        "bytes_on_wire": mig["bytes_on_wire"],
+    }
+
+
+def migration_crossover_tokens(cfg, pcfg, codec="f32", params=None):
+    """Smallest prompt length at which shipping the KV beats recomputing
+    the prefill (``None`` if no prompt admissible under ``pcfg.max_len``
+    ever crosses).  The front door uses this as its routing threshold so
+    the per-request decision is one integer compare, and the SERVING doc
+    quotes it as the crossover the calibration constants imply."""
+    from ..planner.calibrate import default_params
+
+    if params is None:
+        params = default_params()
+    for t in range(1, pcfg.max_len + 1):
+        if plan_migration(cfg, pcfg, t, codec, params)["migrate"]:
+            return t
+    return None

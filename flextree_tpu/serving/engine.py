@@ -57,13 +57,16 @@ from .batcher import BatcherConfig, ContinuousBatcher, Request, SeqState
 from .kv_cache import (
     CacheExhausted,
     PagedCacheConfig,
+    export_blocks,
     gather_seq,
     init_pools,
     make_paged_decode_fn,
+    write_imported,
     write_prefill,
     write_prefill_at,
     write_swapped,
 )
+from .migration import MigrationError, pack_kv, unpack_kv
 
 # cache-occupancy histogram buckets: fractions of the allocatable pool in
 # use, observed once per scheduling round (engine.report() embeds it)
@@ -73,6 +76,13 @@ _OCCUPANCY_BUCKETS = tuple(round(0.1 * i, 1) for i in range(1, 11))
 # measured| / measured of each decode round vs the paged-decode cost
 # estimate (serving/costs.py) — ratio-scaled, not ms-scaled
 _RESIDUAL_BUCKETS = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0)
+
+# migration payload size buckets (bytes on the wire, power-of-4-ish):
+# tiny bench models ship KB, production shapes ship MB — one histogram
+# covers both
+_MIGRATION_BYTES_BUCKETS = (
+    1 << 10, 1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24,
+)
 
 __all__ = ["CompletedRequest", "ServingEngine"]
 
@@ -93,10 +103,21 @@ class CompletedRequest:
     admitted_s: float
     first_token_s: float
     done_s: float
+    # per-token ``_now`` stamps (first token included): consecutive
+    # differences are the inter-token latency samples the disagg bench's
+    # decode-p99 floor is computed from
+    token_times: tuple = ()
 
     @property
     def n_tokens(self) -> int:
         return int(self.tokens.shape[0])
+
+    @property
+    def intervals_s(self) -> tuple:
+        """Inter-token gaps (seconds), one per decode token."""
+        return tuple(
+            b - a for a, b in zip(self.token_times, self.token_times[1:])
+        )
 
     @property
     def ttft_s(self) -> float:
@@ -178,7 +199,21 @@ class ServingEngine:
             write_prefill_at, static_argnums=(3,), donate_argnums=(0,)
         )
         self._write_back = jax.jit(write_swapped, donate_argnums=(0,))
+        # migrated-KV import scatter: block-shaped arrays straight into
+        # the pool (one compile per distinct migrated block count)
+        self._write_import = jax.jit(write_imported, donate_argnums=(0,))
         self._keys: dict = {}  # slot -> presplit (max_new, 2) key rows
+        # rid -> blocks held for an in-flight migration export; released
+        # on the decode side's ack (or the abort path), NEVER before —
+        # the bytes on the wire are a VIEW of these blocks until the
+        # receiver confirms it owns a copy
+        self._exported: dict = {}
+        # chaos knob (set by replica_main from FT_RPC_PREFILL_SLEEP):
+        # stretches every prefill by this many seconds PER COMPUTED
+        # PROMPT TOKEN — prefill cost scales with tokens, so the knob
+        # must too — amplifying the prefill-stall mechanism the disagg
+        # bench measures at CPU scale
+        self.chaos_prefill_sleep_s = 0.0
         self.completed: dict = {}
         self.steps = 0
         self.decode_steps = 0
@@ -447,6 +482,187 @@ class ServingEngine:
         idx = self.batcher.prefix_index
         return idx.clear() if idx is not None else 0
 
+    # ---- prefill/decode disaggregation -------------------------------------
+
+    def prefill_for_migration(self, request: Request, codec: str = "f32"):
+        """The PREFILL replica's half of a migration: run the prompt's
+        prefill, emit the first token (greedy — the RPC tier carries no
+        sampling knobs), and pack the sequence's KV blocks for the wire.
+
+        The blocks stay allocated under ``_exported[rid]`` until
+        :meth:`release_exported` — the ack/abort discipline: releasing
+        before the decode side confirms admission would let a concurrent
+        prefill recycle the blocks while their bytes are still the only
+        copy of this sequence's state.  Returns ``None`` when the pool
+        cannot hold the prompt right now (the caller refuses the request
+        back to the front door); raises :class:`MigrationError` for a
+        request that could NEVER migrate (oversized, sampled)."""
+        req = request
+        if req.temperature > 0:
+            raise MigrationError(
+                f"request {req.rid}: migration is greedy-only "
+                f"(temperature={req.temperature})"
+            )
+        if req.prompt_len < 1 or req.prompt_len >= self.pcfg.max_len:
+            raise MigrationError(
+                f"request {req.rid}: prompt_len {req.prompt_len} outside "
+                f"(0, max_len={self.pcfg.max_len})"
+            )
+        if req.rid in self._exported:
+            raise MigrationError(
+                f"request {req.rid}: migration already in flight"
+            )
+        n = self.pcfg.blocks_for(req.prompt_len)
+        t0 = _now()
+        try:
+            blocks = self.batcher._alloc_with_evict(n)
+        except CacheExhausted:
+            self.metrics.counter("serve.migration_export_blocked").inc()
+            return None
+        record_event(
+            "serve_admit", rid=req.rid, slot=-1,
+            prompt_len=req.prompt_len, blocks=n, migration=True,
+        )
+        prompt = np.asarray(req.prompt, np.int32)
+        logits, cache = self._prefill(self.params, prompt[None])
+        self.pools = self._write(
+            self.pools, cache, np.asarray(blocks, np.int32)
+        )
+        if self.chaos_prefill_sleep_s > 0:
+            time.sleep(self.chaos_prefill_sleep_s * req.prompt_len)
+        first_token = int(np.argmax(np.asarray(logits[0])))
+        kv = export_blocks(self.pools, blocks)
+        kv = {
+            "k": [np.asarray(a) for a in kv["k"]],
+            "v": [np.asarray(a) for a in kv["v"]],
+        }
+        meta, blob = pack_kv(kv, codec=codec)
+        self._exported[req.rid] = blocks
+        now = _now()
+        self.metrics.counter("serve.migration_exports").inc()
+        self.metrics.histogram(
+            "serve.migration_bytes", buckets=_MIGRATION_BYTES_BUCKETS
+        ).observe(len(blob))
+        self.metrics.histogram("serve.ttft_ms").observe(
+            (now - req.arrival_s) * 1e3
+        )
+        from .costs import predict_prefill_us
+
+        record_event(
+            "serve_prefill", rid=req.rid, slot=-1,
+            prompt_len=req.prompt_len, cached_tokens=0,
+            measured_us=round((now - t0) * 1e6, 3),
+            predicted_us=round(
+                predict_prefill_us(
+                    self.cfg, req.prompt_len, self._cost_params()
+                ),
+                3,
+            ),
+        )
+        return {
+            "first_token": first_token,
+            "meta": meta,
+            "blob": blob,
+            "ttft_s": now - req.arrival_s,
+            "prefill_s": now - t0,
+        }
+
+    def release_exported(self, rid: int, acked: bool) -> bool:
+        """Drop the blocks held for ``rid``'s migration export — on the
+        decode side's ACK (the handoff succeeded, the receiver owns a
+        copy) or on the ABORT path (refused, timed out, receiver died;
+        the request goes back to the front door's retry loop).  Exactly
+        one release per export, loud counters either way."""
+        blocks = self._exported.pop(rid, None)
+        if blocks is None:
+            return False
+        self.batcher.allocator.free(blocks)
+        self.metrics.counter(
+            "serve.migration_acked" if acked else "serve.migration_aborted"
+        ).inc()
+        if not acked:
+            record_event("serve_migration_abort", rid=rid,
+                         blocks=len(blocks))
+        return True
+
+    def admit_migrated(self, request: Request, first_token: int,
+                       meta: dict, blob: bytes):
+        """The DECODE replica's half: verify the payload, land the
+        sequence.  Refuse-don't-guess — :class:`MigrationError` for any
+        integrity or geometry violation (CRC, shapes, a block count that
+        does not match the prompt), ``None`` for a clean capacity
+        refusal (no slot / no blocks / resume backlog; the prefill side
+        aborts and the front door retries).  On success the sequence is
+        resident exactly as if prefill had run locally — length =
+        prompt_len, first token recorded, decode continues from the
+        imported blocks on the next :meth:`step`."""
+        req = request
+        total = req.prompt_len + req.max_new_tokens
+        if req.prompt_len < 1 or total > self.pcfg.max_len:
+            raise MigrationError(
+                f"request {req.rid}: prompt+max_new {total} exceeds "
+                f"max_len {self.pcfg.max_len}"
+            )
+        if self.pcfg.blocks_for(total) > self.pcfg.num_blocks - 1:
+            raise MigrationError(
+                f"request {req.rid}: needs {self.pcfg.blocks_for(total)} "
+                f"blocks, pool holds {self.pcfg.num_blocks - 1}"
+            )
+        kv = unpack_kv(meta, blob)  # CRC + per-tensor verification
+        if (
+            int(meta["block_size"]) != self.pcfg.block_size
+            or int(meta["n_heads"]) != self.cfg.n_heads
+            or int(meta["head_dim"]) != self.cfg.head_dim
+            or int(meta["n_layers"]) != self.cfg.n_layers
+        ):
+            raise MigrationError(
+                f"request {req.rid}: payload geometry "
+                f"(bs={meta['block_size']}, H={meta['n_heads']}, "
+                f"Dh={meta['head_dim']}, L={meta['n_layers']}) does not "
+                f"match this replica's model"
+            )
+        n_mig = int(meta["n_blocks"])
+        if n_mig != self.pcfg.blocks_for(req.prompt_len):
+            raise MigrationError(
+                f"request {req.rid}: {n_mig} migrated blocks for a "
+                f"{req.prompt_len}-token prompt "
+                f"(expected {self.pcfg.blocks_for(req.prompt_len)})"
+            )
+        now = _now()
+        admit = self.batcher.admit_migrated(req, first_token, now)
+        if admit is None:
+            self.metrics.counter("serve.migration_refused").inc()
+            record_event(
+                "serve_migration_refuse", rid=req.rid, reason="capacity"
+            )
+            return None
+        slot, state = admit
+        kv_dev = {
+            "k": [jnp.asarray(a, self.cfg.dtype) for a in kv["k"]],
+            "v": [jnp.asarray(a, self.cfg.dtype) for a in kv["v"]],
+        }
+        self.pools = self._write_import(
+            self.pools, kv_dev, np.asarray(state.block_ids[:n_mig], np.int32)
+        )
+        if self.batcher.prefix_index is not None:
+            # mid-stream arrival of already-full blocks: the prompt's
+            # FULL blocks are shareable the moment they land, so the
+            # index adopts them at admission, not at retirement (the
+            # retirement insert walks the same chain idempotently)
+            full = req.prompt_len // self.pcfg.block_size
+            self.batcher.prefix_index.insert(
+                np.asarray(req.prompt), state.block_ids[:full]
+            )
+        self.metrics.counter("serve.migrations_in").inc()
+        self.metrics.histogram(
+            "serve.migration_bytes", buckets=_MIGRATION_BYTES_BUCKETS
+        ).observe(len(blob))
+        record_event(
+            "serve_migration_recv", rid=req.rid, slot=slot,
+            bytes=len(blob), codec=str(meta.get("codec")), blocks=n_mig,
+        )
+        return slot
+
     # ---- prefix-warm drain handoff -----------------------------------------
 
     def _block_hash(self, block: int) -> str:
@@ -613,6 +829,9 @@ class ServingEngine:
                 self.metrics.counter("serve.prefix_misses").inc()
         if self.batcher.prefix_index is not None:
             self._note_prefix_admission(c > 0, t0)
+        if self.chaos_prefill_sleep_s > 0:
+            # per COMPUTED token: a prefix-cache hit only pays its suffix
+            time.sleep(self.chaos_prefill_sleep_s * (req.prompt_len - c))
         if req.temperature > 0:
             if req.seed is None:  # unreachable via submit(); guard direct use
                 raise ValueError(
@@ -666,6 +885,7 @@ class ServingEngine:
             admitted_s=state.admitted_s,
             first_token_s=state.first_token_s,
             done_s=state.done_s,
+            token_times=tuple(state.token_times),
         )
         self.completed[state.rid] = done
         if done.n_tokens > 1:
@@ -688,7 +908,10 @@ class ServingEngine:
 
     # ---- warmup ------------------------------------------------------------
 
-    def warmup(self, prompt_lens, block_counts=(), suffix_buckets=()) -> None:
+    def warmup(
+        self, prompt_lens, block_counts=(), suffix_buckets=(),
+        import_counts=(),
+    ) -> None:
         """Compile the decode step, each distinct prompt length's prefill,
         and each distinct reservation size's pool write before a timed run
         (compiles otherwise land inside the first requests' latency).
@@ -761,6 +984,23 @@ class ServingEngine:
                         np.arange(1, n + 1, dtype=np.int32),
                     )["k"][0]
                 )
+        # migrated-KV import scatter: one compile per inbound block
+        # count — an unwarmed one stalls the decode replica's engine
+        # loop mid-handoff, landing inside the very inter-token p99 the
+        # disaggregation exists to protect
+        shape = (self.pcfg.block_size, self.cfg.n_heads, self.cfg.head_dim)
+        for n in sorted(set(int(n) for n in import_counts)):
+            zeros = [
+                jnp.zeros((n, *shape), self.cfg.dtype)
+                for _ in range(self.cfg.n_layers)
+            ]
+            jax.block_until_ready(
+                self._write_import(
+                    init_pools(self.cfg, self.pcfg),
+                    {"k": zeros, "v": zeros},
+                    np.arange(1, n + 1, dtype=np.int32),
+                )["k"][0]
+            )
         bs = self.pcfg.block_size
         for c, s in sorted(set((int(c), int(s)) for c, s in suffix_buckets)):
             if c < 1 or s < 1:

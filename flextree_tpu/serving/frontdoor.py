@@ -113,6 +113,16 @@ class FrontDoorConfig:
     # replica default block size so the span is exactly one cacheable
     # block.
     affinity_span: int = 8
+    # disaggregation: prompts of at least ``migrate_min_prompt_len``
+    # tokens route to a dedicated prefill replica, which ships the
+    # finished KV (coded per ``migrate_codec``) to a decode replica and
+    # hands the request off there; ``None`` disables migration and every
+    # request runs colocated.  Set the threshold from
+    # ``costs.migration_crossover_tokens`` so the per-request
+    # migrate-vs-recompute decision is one integer compare against the
+    # planner's crossover — short prompts never pay the hop.
+    migrate_min_prompt_len: int | None = None
+    migrate_codec: str = "f32"
     # workers + membership thresholds (match SupervisorConfig defaults)
     dispatchers: int = 4
     straggler_s: float = 1.0
@@ -130,6 +140,11 @@ class FrontDoorResult:
     rank: int  # the replica whose attempt won
     attempts: int  # launches it took (1 = clean first try)
     hedged: bool
+    migrated: bool = False  # prefill ran on a prefill replica, KV shipped
+    # replica-measured gaps between consecutive emitted tokens (len =
+    # n_tokens - 1): the decode inter-token latency, free of front-door
+    # queueing — what the disaggregation bench prices its p99 floor on
+    intervals_s: tuple = ()
 
 
 class ReplicaClient:
@@ -142,6 +157,8 @@ class ReplicaClient:
         self.host: str | None = None  # guarded-by: _lock
         self.port: int | None = None  # guarded-by: _lock
         self.pid: int | None = None  # guarded-by: _lock
+        self.role = "both"  # guarded-by: _lock (from the endpoint file)
+        self.prefill_depth = 0  # guarded-by: _lock (replica-reported)
         self.conn: RpcConnection | None = None  # guarded-by: _lock
         self.outstanding = 0  # guarded-by: _lock
         self.strikes = 0  # guarded-by: _lock
@@ -152,17 +169,22 @@ class ReplicaClient:
         )
         self._lock = threading.Lock()
 
-    def update_endpoint(self, host: str, port: int, pid: int) -> None:
+    def update_endpoint(
+        self, host: str, port: int, pid: int, role: str = "both"
+    ) -> None:
         # called from whichever dispatcher thread refreshes first, racing
         # connection() on other dispatchers — same lock, or a half-updated
         # endpoint can be dialed
         with self._lock:
-            if (host, port, pid) == (self.host, self.port, self.pid):
+            if (host, port, pid, role) == (
+                self.host, self.port, self.pid, self.role
+            ):
                 return
             # a replaced process (same rank, new pid/port): drop the old
             # connection, the next attempt dials the new endpoint
             old, self.conn = self.conn, None
             self.host, self.port, self.pid = host, port, pid
+            self.role = role
         if old is not None:
             old.close()
 
@@ -274,6 +296,14 @@ class FrontDoor:
         self._affinity: dict[int, int] = {}  # guarded-by: _lock
         self._rid_phash: dict[int, int] = {}  # guarded-by: _lock
         self._inflight: set[int] = set()  # guarded-by: _lock
+        # destined role per inflight rid: shed accounting is per role so
+        # a flood of long prompts filling the prefill tier can't shed
+        # decode-bound traffic (and vice versa)
+        self._inflight_role: dict[int, str] = {}  # guarded-by: _lock
+        # arrival->first-token of a completed handoff, stamped when the
+        # prefill replica reports the migration done; the collect
+        # attempt's own ttft would otherwise overwrite the real one
+        self._migration_ttft: dict[int, float] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._work: queue.Queue = queue.Queue()
         self._stop = threading.Event()
@@ -322,6 +352,7 @@ class FrontDoor:
             try:
                 rank = int(ep["rank"])
                 host, port, pid = ep["host"], int(ep["port"]), int(ep["pid"])
+                role = str(ep.get("role", "both"))
             except (KeyError, ValueError, TypeError):
                 continue
             # the insert races other dispatchers' refresh() calls AND
@@ -333,14 +364,23 @@ class FrontDoor:
                     client = self.clients[rank] = ReplicaClient(
                         rank, self.cfg
                     )
-            client.update_endpoint(host, port, pid)
+            client.update_endpoint(host, port, pid, role)
 
-    def _routable(self, exclude=(), prefer=None) -> "ReplicaClient | None":
+    def _routable(
+        self, exclude=(), prefer=None, role="decode"
+    ) -> "ReplicaClient | None":
         """Healthy first, then stragglers; least-outstanding within the
         tier; DEAD and breaker-open replicas never.  ``prefer`` names a
         rank to pick over the load balance IF it survives every health /
         breaker / exclusion filter into the healthy tier — affinity is a
-        tiebreak inside the safe set, never a way back into it."""
+        tiebreak inside the safe set, never a way back into it.
+
+        ``role`` selects the routing tier: ``"decode"`` (plain and
+        collect generates — decode and colocated replicas,
+        least-outstanding) or ``"prefill"`` (migrate-flagged prefills —
+        dedicated prefill replicas only, weighted by their reported
+        intake queue depth plus our outstanding count, so a replica
+        digesting a deep prefill backlog stops attracting more)."""
         self.refresh()
         states = {r: s.state for r, s in self.membership.poll().items()}
         now = _now()
@@ -349,6 +389,13 @@ class FrontDoor:
         tiers: dict[str, list[ReplicaClient]] = {"healthy": [], "other": []}
         for rank, client in clients:
             if rank in exclude or client.breaker_open(now):
+                continue
+            if role == "prefill":
+                if client.role != "prefill":
+                    continue
+            elif client.role == "prefill":
+                # dedicated prefill replicas shed plain generates with a
+                # "role" refusal — never route one there
                 continue
             state = states.get(rank)
             if state == DEAD:
@@ -361,9 +408,13 @@ class FrontDoor:
                     self.metrics.counter("serve.affinity_routed").inc()
                     return client
             self.metrics.counter("serve.affinity_miss").inc()
+        if role == "prefill":
+            load = lambda c: (c.prefill_depth + c.outstanding, c.rank)
+        else:
+            load = lambda c: (c.outstanding, c.rank)
         for tier in (tiers["healthy"], tiers["other"]):
             if tier:
-                return min(tier, key=lambda c: (c.outstanding, c.rank))
+                return min(tier, key=load)
         return None
 
     # ---- intake ------------------------------------------------------------
@@ -383,13 +434,26 @@ class FrontDoor:
             # shed decision: whether this is a predicted hit decides how
             # much headroom it gets
             phash = zlib.crc32(p[:span].tobytes())
+        # the destined role decides whose capacity this request consumes:
+        # a long prompt heads for the prefill tier, so admitting or
+        # shedding it is a PREFILL capacity decision — counting it
+        # against decode capacity would let a heavy-prefill tail shed
+        # decode-bound traffic it never competes with (and vice versa)
+        role = "prefill" if (
+            self.cfg.migrate_min_prompt_len is not None
+            and len(p) >= self.cfg.migrate_min_prompt_len
+        ) else "decode"
         with self._lock:
-            inflight = len(self._inflight)
+            inflight = sum(
+                1 for r in self._inflight
+                if self._inflight_role.get(r, "decode") == role
+            )
             headroom = self.cfg.shed_hit_headroom
             hit = phash is not None and phash in self._affinity
             limit = self.cfg.shed_outstanding + (headroom if hit else 0)
             if inflight >= limit:
                 self.metrics.counter("serve.shed").inc()
+                self.metrics.counter(f"serve.shed_{role}").inc()
                 if not hit and inflight < (
                     self.cfg.shed_outstanding + headroom
                 ):
@@ -400,11 +464,12 @@ class FrontDoor:
                 record_event(
                     "serve_shed", rid=rid, where="frontdoor",
                     inflight=inflight, reason="FT_RPC_SHED",
-                    predicted_hit=hit,
+                    predicted_hit=hit, role=role,
                 )
                 return False
             self._arrival.setdefault(rid, _now())
             self._inflight.add(rid)
+            self._inflight_role[rid] = role
             if phash is not None:
                 self._rid_phash[rid] = phash
         self._work.put((rid, p, int(max_new_tokens)))
@@ -436,6 +501,7 @@ class FrontDoor:
             finally:
                 with self._lock:
                     self._inflight.discard(rid)
+                    self._inflight_role.pop(rid, None)
 
     def _next_attempt(self, rid: int) -> int:
         with self._lock:
@@ -494,6 +560,16 @@ class FrontDoor:
         deadline = arrival + cfg.request_timeout_s
         backoff = cfg.backoff_base_s
         avoid: set = set()  # ranks that drain-refused this rid
+        # the planner decision, folded to one compare: prompts past the
+        # calibrated crossover ship their KV, shorter ones never pay the
+        # hop.  Flips off for the rest of THIS rid on any handoff (the
+        # sequence now lives on the decode side — collect, don't re-ship)
+        # or migrate failure (fall back to the colocated path).
+        migrate = (
+            cfg.migrate_min_prompt_len is not None
+            and len(prompt) >= cfg.migrate_min_prompt_len
+        )
+        prefer_pin = None  # decode rank a completed handoff pinned us to
         while True:
             now = _now()
             if now >= deadline:
@@ -506,7 +582,32 @@ class FrontDoor:
                 phash = self._rid_phash.get(rid)
                 prefer = self._affinity.get(phash) if phash is not None \
                     else None
-            client = self._routable(exclude=avoid, prefer=prefer)
+            if prefer_pin is not None:
+                prefer = prefer_pin
+            client = None
+            extra = None
+            if migrate:
+                pre = self._routable(exclude=avoid, role="prefill")
+                tgt = self._routable(prefer=prefer, role="decode")
+                if pre is not None and tgt is not None:
+                    with tgt._lock:
+                        host, port = tgt.host, tgt.port
+                    if host is not None:
+                        client = pre
+                        extra = {
+                            "migrate_to": {
+                                "host": host, "port": int(port),
+                                "rank": tgt.rank,
+                            },
+                            "codec": cfg.migrate_codec,
+                        }
+                if client is None:
+                    # no dedicated prefill tier (or no decode target)
+                    # routable right now: the colocated path still
+                    # works — don't strand the request on a preference
+                    migrate = False
+            if client is None:
+                client = self._routable(exclude=avoid, prefer=prefer)
             if client is None and avoid:
                 # everyone left has drain-refused us: better a draining
                 # replica (it may still be up) than nobody
@@ -519,11 +620,30 @@ class FrontDoor:
                 backoff = min(backoff * 2.0, cfg.backoff_cap_s)
                 continue
             verdict = self._attempt_round(
-                rid, prompt, max_new, client, deadline
+                rid, prompt, max_new, client, deadline, extra=extra
             )
             kind = verdict[0]
             if kind == "done":
                 return
+            if kind == "handoff":
+                # the prefill replica already emitted the first token and
+                # the decode replica holds the sequence: the remaining
+                # work is a collect generate there, which attaches to the
+                # in-flight sequence through the replica's dedup path
+                migrate = False
+                prefer_pin = verdict[1]
+                avoid.discard(verdict[1])
+                self.metrics.counter("serve.migrations").inc()
+                continue
+            if kind == "migrate_failed":
+                # the prefill replica aborted the handoff (ship failed or
+                # the decode side refused) and released its export: fall
+                # back to a plain colocated generate for this rid
+                migrate = False
+                self.metrics.counter("serve.migration_fallback").inc()
+                record_event("serve_migration_fallback", rid=rid,
+                             code=verdict[1])
+                continue
             if kind == "drain":
                 # the replica is leaving, not failing: re-route at once,
                 # and not back to the drainer
@@ -542,11 +662,16 @@ class FrontDoor:
             backoff = min(backoff * 2.0, cfg.backoff_cap_s)
 
     def _attempt_round(
-        self, rid, prompt, max_new, client: ReplicaClient, deadline: float
+        self, rid, prompt, max_new, client: ReplicaClient, deadline: float,
+        extra: dict | None = None,
     ):
         """One primary attempt plus up to ``max_hedges`` hedges; first
-        usable outcome wins.  Returns ``("done",)``, ``("drain", rank)``
-        or ``("retry", code)``."""
+        usable outcome wins.  Returns ``("done",)``, ``("drain", rank)``,
+        ``("retry", code)``, or — for a migrate-flagged attempt
+        (``extra`` carries ``migrate_to`` + ``codec``) —
+        ``("handoff", decode_rank)`` / ``("migrate_failed", code)``.
+        Migrate attempts never hedge: a twin would ship a second KV copy
+        for the dedup path to discard."""
         cfg = self.cfg
         resq: queue.Queue = queue.Queue()
         hedged = False
@@ -565,13 +690,15 @@ class FrontDoor:
                 "max_new_tokens": max_new,
                 "deadline_in_s": round(remaining, 6),
             }
+            if extra:
+                payload.update(extra)
             timeout = min(cfg.attempt_timeout_s, max(remaining, 1e-3))
             self._launch_attempt(target, payload, timeout, resq)
             tried.append(target.rank)
             outstanding += 1
 
         _fire(client)
-        hedge_delay = self._hedge_delay_s()
+        hedge_delay = None if extra else self._hedge_delay_s()
         hedges = 0
         last_code = RpcTimeout.code
         while outstanding:
@@ -612,10 +739,34 @@ class FrontDoor:
                 (_now() - send_mono) * 1e3
             )
             reply = payload
+            if reply.get("prefill_depth") is not None:
+                # piggybacked intake depth: the signal the prefill tier's
+                # queue-depth-weighted routing balances on
+                with rep._lock:
+                    rep.prefill_depth = int(reply["prefill_depth"])
             if reply.get("drain"):
                 return ("drain", rep.rank)
+            if reply.get("handoff"):
+                # migration done: first token is out, the sequence lives
+                # on the decode replica.  Stamp the REAL ttft now — the
+                # collect attempt's ttft_s would measure the attach, not
+                # the prefill
+                ttft_s = (send_mono - self._arrival[rid]) + float(
+                    reply["ttft_s"]
+                )
+                with self._lock:
+                    self._migration_ttft.setdefault(rid, ttft_s)
+                rep.clear_strikes()
+                record_event(
+                    "serve_migration_handoff", rid=rid,
+                    prefill=rep.rank, decode=int(reply["decode_rank"]),
+                    ttft_ms=round(ttft_s * 1e3, 3),
+                )
+                return ("handoff", int(reply["decode_rank"]))
             if not reply.get("ok"):
                 code = reply.get("code", "FT_RPC_ERROR")
+                if reply.get("migrate_failed"):
+                    return ("migrate_failed", code)
                 last_code = code
                 if code == RpcShed.code:
                     record_event("serve_shed_upstream", rid=rid,
@@ -675,6 +826,13 @@ class FrontDoor:
         """First writer wins; a hedge race's loser is counted, dropped."""
         arrival = self._arrival[rid]
         ttft_s = (send_mono - arrival) + float(reply["ttft_s"])
+        with self._lock:
+            mig_ttft = self._migration_ttft.pop(rid, None)
+        if mig_ttft is not None:
+            # the first token came out of the prefill replica during the
+            # handoff round; this reply's ttft_s timed the decode-side
+            # attach, which is not what the client experienced
+            ttft_s = mig_ttft
         result = FrontDoorResult(
             rid=rid,
             tokens=np.asarray(reply["tokens"], np.int32),
@@ -682,6 +840,10 @@ class FrontDoor:
             rank=int(reply["rank"]),
             attempts=self._attempts_used(rid),
             hedged=hedged,
+            migrated=mig_ttft is not None,
+            intervals_s=tuple(
+                float(d) for d in reply.get("intervals_s", ())
+            ),
         )
         with self._lock:
             if rid in self.completed:
@@ -710,6 +872,7 @@ class FrontDoor:
                 return
             self.failed[rid] = code
             self._rid_phash.pop(rid, None)
+            self._migration_ttft.pop(rid, None)
         self.metrics.counter("serve.failed").inc()
         record_event("serve_failed", rid=rid, code=code)
 

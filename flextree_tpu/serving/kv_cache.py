@@ -78,6 +78,8 @@ __all__ = [
     "paged_decode_step",
     "make_paged_decode_fn",
     "gather_seq",
+    "export_blocks",
+    "write_imported",
 ]
 
 #: Block id 0 is reserved: it pads table rows and is never allocated.
@@ -406,6 +408,52 @@ def make_paged_decode_fn(cfg: TransformerConfig, donate: bool = True,
         partial(paged_decode_step, cfg=cfg, fused=fused, impl=impl),
         donate_argnums=(1,) if donate else (),
     )
+
+
+def export_blocks(pools: dict, block_ids) -> dict:
+    """Pull a sequence's blocks out of the pool at BLOCK granularity —
+    per-layer ``(n, bs, H, Dh)`` — for migration to another replica.
+
+    This is deliberately NOT :func:`gather_seq`: no ``(n*bs, H, Dh)``
+    contiguous row is ever materialized.  The wire payload ships blocks
+    exactly as the pool stores them, and the importing side scatters the
+    same block-shaped arrays straight back with :func:`write_imported` —
+    so the f32 path moves the pool bytes verbatim (the bitwise-identity
+    argument) and neither side pays a reshape/copy beyond the device→host
+    transfer itself.
+    """
+    idx = jnp.asarray(block_ids, jnp.int32)
+    return {
+        "k": [pk[idx] for pk in pools["k"]],
+        "v": [pv[idx] for pv in pools["v"]],
+    }
+
+
+def write_imported(pools: dict, kv: dict, block_ids) -> dict:
+    """Scatter migrated block-shaped K/V into newly assigned blocks — the
+    receiving half of :func:`export_blocks`.
+
+    ``kv`` is per-layer ``{"k": [(n, bs, H, Dh)], "v": [...]}`` with
+    exactly ``len(block_ids)`` blocks.  Positions in the final block past
+    the migrated sequence's length sit at or beyond its causal bound, so
+    — the same over-scatter argument as :func:`write_swapped` — whatever
+    the tail holds is invisible until decode writes overwrite it.  On the
+    f32 codec the scattered bytes are the exact bytes
+    :func:`export_blocks` read, which is what keeps a migrated decode
+    bitwise against the colocated engine.
+    """
+    idx = jnp.asarray(block_ids, jnp.int32)
+    n = idx.shape[0]
+    out_k, out_v = [], []
+    for pk, pv, k, v in zip(pools["k"], pools["v"], kv["k"], kv["v"]):
+        if k.shape[0] != n or k.shape[1:] != pk.shape[1:]:
+            raise ValueError(
+                f"imported K/V shaped {tuple(k.shape)}, "
+                f"{n} blocks of {tuple(pk.shape[1:])} expected"
+            )
+        out_k.append(pk.at[idx].set(k))
+        out_v.append(pv.at[idx].set(v))
+    return {"k": out_k, "v": out_v}
 
 
 def gather_seq(pools: dict, block_ids, length: int | None = None) -> dict:

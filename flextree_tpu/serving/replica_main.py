@@ -36,13 +36,29 @@ in-flight request with a ``drain`` refusal (the front door re-queues to
 survivors — PR 9's re-route rule, now across a wire), flush the flight
 record, exit 0.
 
-Chaos knobs (env, used by ``tools/rpc_chaos.py``; OFF by default):
+**Roles** (``--role {prefill,decode,both}``): a ``prefill`` replica only
+accepts migrate-flagged generates — it runs the prompt's prefill, emits
+the first token, and ships the KV blocks to the decode replica named in
+the request (``kv_chunk`` stream + ``kv_admit`` handshake over the same
+framed RPC, blocks held until the ack); a ``decode`` replica runs the
+normal engine loop and additionally lands migrated sequences
+(``engine.admit_migrated`` — verify, scatter, decode from there);
+``both`` (the default) is the colocated engine unchanged.  The endpoint
+file carries the role so the front door can tier its routing.
+
+Chaos knobs (env, used by ``tools/rpc_chaos.py`` and
+``tools/bench_disagg.py``; OFF by default):
 
 - ``FT_RPC_TEAR_EVERY=k`` — corrupt a byte inside every k-th response
   frame's payload (length header intact, so the stream stays aligned
   and the client's CRC check is what catches it);
 - ``FT_RPC_DECODE_SLEEP=s`` — stretch every decode round by ``s``
-  seconds, widening the window for a mid-decode SIGKILL / SIGSTOP.
+  seconds, widening the window for a mid-decode SIGKILL / SIGSTOP;
+- ``FT_RPC_PREFILL_SLEEP=s`` — stretch every prefill by ``s`` seconds
+  per computed prompt token
+  (applied to ALL roles equally): scales the prefill:decode cost ratio
+  toward production shapes so the colocated prefill stall the disagg
+  bench measures is visible at tiny-model CPU scale.
 """
 
 from __future__ import annotations
@@ -60,17 +76,29 @@ from ..obs import record_event
 from ..runtime.ctrlfile import write_control_json
 from ..runtime.supervisor import Supervisor, SupervisorConfig
 from ..utils.logging import get_logger
-from .rpc import RpcError, encode_frame, recv_frame
+from .migration import MigrationError
+from .rpc import (
+    RpcConnection,
+    RpcError,
+    chunk_blob,
+    encode_frame,
+    join_chunks,
+    recv_frame,
+)
 
-__all__ = ["ENDPOINT_FMT", "ReplicaConfig", "ReplicaServer", "main"]
+__all__ = ["ENDPOINT_FMT", "ROLES", "ReplicaConfig", "ReplicaServer", "main"]
 
 log = get_logger("flextree.serving")
 
 ENDPOINT_FMT = "rpc_{rank:05d}.json"
 
+#: replica roles; ``serve.role`` gauge encodes them in this tuple's order
+ROLES = ("both", "prefill", "decode")
+
 #: chaos env knobs (documented in docs/FAILURE_MODEL.md §RPC failures)
 FT_RPC_TEAR_EVERY_ENV = "FT_RPC_TEAR_EVERY"
 FT_RPC_DECODE_SLEEP_ENV = "FT_RPC_DECODE_SLEEP"
+FT_RPC_PREFILL_SLEEP_ENV = "FT_RPC_PREFILL_SLEEP"
 
 
 class ReplicaConfig:
@@ -85,13 +113,17 @@ class ReplicaConfig:
         port: int = 0,
         max_pending: int = 64,
         idle_poll_s: float = 0.02,
+        role: str = "both",
     ):
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r}; one of {ROLES}")
         self.rank = int(rank)
         self.dir = dir
         self.host = host
         self.port = int(port)
         self.max_pending = int(max_pending)
         self.idle_poll_s = float(idle_poll_s)
+        self.role = role
 
 
 class ReplicaServer:
@@ -127,6 +159,17 @@ class ReplicaServer:
         self._tear_every = int(tear) if tear else 0
         sleep = os.environ.get(FT_RPC_DECODE_SLEEP_ENV)
         self._decode_sleep = float(sleep) if sleep else 0.0
+        psleep = os.environ.get(FT_RPC_PREFILL_SLEEP_ENV)
+        if psleep:
+            # applied to EVERY role (colocated included): the knob scales
+            # the prefill:decode ratio, it must not bias the comparison
+            engine.chaos_prefill_sleep_s = float(psleep)
+        # migration state — engine-thread only (like the engine itself):
+        # rid -> buffered inbound KV chunks, and cached client
+        # connections to decode replicas for outbound shipping
+        self._kv_buf: dict[int, list] = {}
+        self._mig_conns: dict[tuple, RpcConnection] = {}
+        engine.metrics.gauge("serve.role").set(ROLES.index(cfg.role))
 
     # ---- lifecycle ---------------------------------------------------------
 
@@ -152,6 +195,7 @@ class ReplicaServer:
                 "pid": os.getpid(),
                 "host": self.cfg.host,
                 "port": self.port,
+                "role": self.cfg.role,
                 "wall": time.time(),
             },
         )
@@ -177,6 +221,9 @@ class ReplicaServer:
             except OSError:
                 pass
         self._close_conns()
+        for c in self._mig_conns.values():
+            c.close()
+        self._mig_conns.clear()
         for t in self._threads:
             t.join(timeout=2.0)
         # a connection the acceptor admitted DURING the close sweep above
@@ -264,11 +311,27 @@ class ReplicaServer:
             timeout = 0.0  # only the first get() blocks
             self._handle(conn, payload, recv_mono)
 
+    def _prefill_depth(self) -> int:
+        """Prefill backlog right now: migrate work still parked in intake
+        (handling is synchronous on the engine thread, so intake IS the
+        queue).  Exported as a gauge and piggybacked on every reply a
+        prefill replica sends — the front door's dispatch weight."""
+        depth = self._intake.qsize()
+        self.engine.metrics.gauge("serve.prefill_queue_depth").set(depth)
+        return depth
+
     def _handle(self, conn, payload: dict, recv_mono: float) -> None:
         corr = payload.get("corr")
         kind = payload.get("kind")
         if kind == "ping":
-            self._respond(conn, corr, {"ok": True, "rank": self.cfg.rank})
+            self._respond(
+                conn, corr,
+                {"ok": True, "rank": self.cfg.rank, "role": self.cfg.role,
+                 "prefill_depth": self._prefill_depth()},
+            )
+            return
+        if kind in ("kv_chunk", "kv_admit"):
+            self._handle_kv(conn, corr, kind, payload, recv_mono)
             return
         if kind != "generate":
             self._respond(
@@ -282,6 +345,27 @@ class ReplicaServer:
         if self.draining.is_set():
             self._respond(
                 conn, corr, {"ok": False, "drain": True, "rid": rid}
+            )
+            return
+        if payload.get("migrate_to") is not None:
+            if self.cfg.role == "decode":
+                # mis-routed: decode replicas never run the prefill half
+                self._respond(
+                    conn, corr,
+                    {"ok": False, "code": "FT_RPC_SHED", "rid": rid,
+                     "reason": "role"},
+                )
+                return
+            self._handle_migrate(conn, corr, payload, recv_mono)
+            return
+        if self.cfg.role == "prefill":
+            # a prefill replica holds no decode slots for the fleet: a
+            # plain generate here would silently recreate the colocated
+            # stall disaggregation exists to remove
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": "FT_RPC_SHED", "rid": rid,
+                 "reason": "role"},
             )
             return
         # deadline propagation: the front door sends the REMAINING budget
@@ -349,6 +433,250 @@ class ReplicaServer:
         self._waiters[rid] = [(conn, corr, attempt)]
         self._recv_stamp[rid] = recv_mono
 
+    # ---- migration: the prefill half (runs on the engine thread) -----------
+
+    def _handle_migrate(self, conn, corr, payload: dict,
+                        recv_mono: float) -> None:
+        """Prefill + ship + reply: the whole export→ship→admit-or-refuse→
+        release handshake, synchronous on the engine thread (a prefill
+        replica's engine has no resident decodes to starve; the intake
+        backlog is the queue depth the front door weighs)."""
+        import numpy as np
+
+        from .batcher import Request
+
+        rid = int(payload["rid"])
+        attempt = int(payload.get("attempt", 0))
+        to = payload["migrate_to"]
+        codec = str(payload.get("codec", "f32"))
+        deadline = payload.get("deadline_in_s")
+        if deadline is not None and float(deadline) <= 0.0:
+            self.engine.metrics.counter("serve.deadline_refused").inc()
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": "FT_RPC_TIMEOUT", "rid": rid},
+            )
+            return
+        req = Request(
+            rid=rid,
+            prompt=np.asarray(payload["prompt"], np.int32),
+            max_new_tokens=int(payload["max_new_tokens"]),
+            arrival_s=recv_mono,
+        )
+        t0 = time.monotonic()
+        try:
+            out = self.engine.prefill_for_migration(req, codec=codec)
+        except MigrationError as e:
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": MigrationError.code, "rid": rid,
+                 "error": str(e), "migrate_failed": True},
+            )
+            return
+        if out is None:  # pool cannot hold the prompt right now
+            self.engine.metrics.counter("serve.shed_prefill").inc()
+            record_event("serve_shed", rid=rid, attempt=attempt,
+                         where="replica", role="prefill",
+                         reason="export_blocked")
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": "FT_RPC_SHED", "rid": rid,
+                 "reason": "export_blocked",
+                 "prefill_depth": self._prefill_depth()},
+            )
+            return
+        remaining = None
+        if deadline is not None:
+            remaining = float(deadline) - (time.monotonic() - recv_mono)
+        ship_timeout = max(min(10.0 if remaining is None else remaining,
+                               10.0), 0.5)
+        try:
+            reply = self._ship_kv(to, rid, attempt, payload, out,
+                                  timeout_s=ship_timeout)
+        except (RpcError, OSError, KeyError, TypeError, ValueError) as e:
+            # receiver unreachable, died mid-stream, or spoke garbage:
+            # ABORT — release our export, let the front door retry
+            self.engine.release_exported(rid, acked=False)
+            self.engine.metrics.counter("serve.migration_ship_failed").inc()
+            record_event("serve_migration_ship_failed", rid=rid,
+                         error=str(e)[:120])
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": "FT_RPC_CONN_REFUSED", "rid": rid,
+                 "migrate_failed": True, "error": str(e)[:120]},
+            )
+            return
+        if not reply.get("ok") or not reply.get("admitted"):
+            # clean refusal from the decode side (capacity or poisoned):
+            # same abort discipline, different loudness
+            self.engine.release_exported(rid, acked=False)
+            self.engine.metrics.counter("serve.migration_ship_refused").inc()
+            record_event("serve_migration_ship_refused", rid=rid,
+                         code=reply.get("code"))
+            self._respond(
+                conn, corr,
+                {"ok": False,
+                 "code": str(reply.get("code", MigrationError.code)),
+                 "rid": rid, "migrate_failed": True},
+            )
+            return
+        # ACK: the decode side owns a verified copy — NOW the blocks go
+        self.engine.release_exported(rid, acked=True)
+        ship_ms = (time.monotonic() - t0) * 1e3
+        self.engine.metrics.histogram("serve.migration_ms").observe(ship_ms)
+        record_event(
+            "serve_migration_send", rid=rid,
+            to_rank=int(to.get("rank", -1)), codec=codec,
+            bytes=len(out["blob"]), ms=round(ship_ms, 3),
+        )
+        self._respond(
+            conn, corr,
+            {"ok": True, "rid": rid, "attempt": attempt,
+             "rank": self.cfg.rank, "handoff": True,
+             "decode_rank": int(to.get("rank", -1)),
+             "ttft_s": round(out["ttft_s"], 6),
+             "prefill_depth": self._prefill_depth()},
+        )
+
+    def _ship_kv(self, to: dict, rid: int, attempt: int, payload: dict,
+                 out: dict, *, timeout_s: float) -> dict:
+        """Stream the packed KV to the decode replica: bounded
+        ``kv_chunk`` frames, then the ``kv_admit`` frame carrying the
+        meta, the first token, and the request — the receiver's
+        admit-or-refuse comes back as this call's reply."""
+        key = (str(to["host"]), int(to["port"]))
+        conn = self._mig_conns.get(key)
+        if conn is None or conn.dead is not None:
+            conn = RpcConnection.connect(
+                key[0], key[1], timeout_s=min(timeout_s, 2.0)
+            )
+            self._mig_conns[key] = conn
+        chunks = chunk_blob(out["blob"])
+        try:
+            for i, c in enumerate(chunks[:-1]):
+                ack = conn.call(
+                    {"kind": "kv_chunk", "rid": rid, "seq": i, "chunk": c},
+                    timeout_s=timeout_s,
+                )
+                if not ack.get("ok"):
+                    return ack
+            return conn.call(
+                {
+                    "kind": "kv_admit",
+                    "rid": rid,
+                    "attempt": attempt,
+                    "seq": len(chunks) - 1,
+                    "total": len(chunks),
+                    "chunk": chunks[-1],
+                    "meta": out["meta"],
+                    "first_token": out["first_token"],
+                    "prompt": [int(t) for t in payload["prompt"]],
+                    "max_new_tokens": int(payload["max_new_tokens"]),
+                },
+                timeout_s=timeout_s,
+            )
+        except RpcError:
+            self._mig_conns.pop(key, None)
+            raise
+
+    # ---- migration: the decode half (runs on the engine thread) ------------
+
+    def _handle_kv(self, conn, corr, kind: str, payload: dict,
+                   recv_mono: float) -> None:
+        """Receive a KV transfer: buffer ``kv_chunk`` frames, then on
+        ``kv_admit`` reassemble, verify, and land the sequence
+        (admit-or-refuse — never a queue: the prefill side is holding
+        blocks against our answer)."""
+        import numpy as np
+
+        from .batcher import Request
+
+        rid = int(payload["rid"])
+        if self.draining.is_set() or self.cfg.role == "prefill":
+            self._kv_buf.pop(rid, None)
+            self._respond(
+                conn, corr,
+                {"ok": False, "drain": self.draining.is_set(), "rid": rid,
+                 "code": "FT_RPC_SHED", "reason": "role"
+                 if self.cfg.role == "prefill" else "drain"},
+            )
+            return
+        if kind == "kv_chunk":
+            buf = self._kv_buf.setdefault(rid, [])
+            # a runaway stream must not buffer unbounded bytes: cap at
+            # what MAX_FRAME_BYTES-bounded chunks can legitimately need
+            # for one pool's worth of blocks
+            if len(buf) >= 64:
+                self._kv_buf.pop(rid, None)
+                self._respond(
+                    conn, corr,
+                    {"ok": False, "code": MigrationError.code, "rid": rid,
+                     "error": "chunk stream exceeds buffer cap"},
+                )
+                return
+            buf.append((int(payload["seq"]), str(payload["chunk"])))
+            self._respond(conn, corr, {"ok": True, "rid": rid,
+                                       "seq": int(payload["seq"])})
+            return
+        # ---- kv_admit: reassemble + verify + admit -------------------
+        parts = self._kv_buf.pop(rid, [])
+        parts.append((int(payload["seq"]), str(payload["chunk"])))
+        total = int(payload.get("total", len(parts)))
+        seqs = [s for s, _ in parts]
+        if sorted(seqs) != list(range(total)):
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": MigrationError.code, "rid": rid,
+                 "error": f"chunk sequence {sorted(seqs)} != 0..{total - 1}"},
+            )
+            return
+        # idempotent re-send (the prefill side retried after a lost ack):
+        # the sequence is already ours — ack again, never double-admit
+        inflight = {r.rid for r in self.engine.batcher.inflight_requests()}
+        if rid in self.engine.completed or rid in inflight:
+            self.engine.metrics.counter("serve.dedup_hits").inc()
+            record_event("serve_dedup", rid=rid, stage="migrated")
+            self._respond(conn, corr,
+                          {"ok": True, "admitted": True, "rid": rid,
+                           "dup": True})
+            return
+        try:
+            blob = join_chunks(c for _, c in sorted(parts))
+            req = Request(
+                rid=rid,
+                prompt=np.asarray(payload["prompt"], np.int32),
+                max_new_tokens=int(payload["max_new_tokens"]),
+                arrival_s=recv_mono,
+            )
+            slot = self.engine.admit_migrated(
+                req, int(payload["first_token"]), payload["meta"], blob
+            )
+        except (RpcError, MigrationError, KeyError, TypeError,
+                ValueError) as e:
+            self.engine.metrics.counter("serve.migration_poisoned").inc()
+            record_event("serve_migration_refuse", rid=rid,
+                         reason="poisoned", error=str(e)[:120])
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": MigrationError.code, "rid": rid,
+                 "error": str(e)[:200]},
+            )
+            return
+        if slot is None:  # capacity refusal (counted by the engine)
+            self._respond(
+                conn, corr,
+                {"ok": False, "code": "FT_RPC_SHED", "rid": rid,
+                 "reason": "capacity"},
+            )
+            return
+        # a placeholder waiter entry makes the rid IN-FLIGHT to the
+        # dedup path: the front door's collect-generate attaches here
+        # instead of re-submitting a resident sequence
+        self._waiters.setdefault(rid, [])
+        self._recv_stamp[rid] = recv_mono
+        self._respond(conn, corr,
+                      {"ok": True, "admitted": True, "rid": rid})
+
     def _flush_completions(self) -> None:
         if not self._waiters:
             return
@@ -373,6 +701,9 @@ class ReplicaServer:
             # door adds its own queue/retry time on its clock
             "ttft_s": round(done.ttft_s, 6),
             "decode_s": round(done.done_s - done.first_token_s, 6),
+            # per-decode-token gaps on this clock: the inter-token
+            # latency samples the disagg bench's p99 floor reads
+            "intervals_s": [round(d, 6) for d in done.intervals_s],
         }
 
     def _drain(self) -> None:
@@ -441,6 +772,10 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--max-pending", type=int, default=64)
+    ap.add_argument("--role", choices=ROLES, default="both",
+                    help="prefill: migrate-flagged generates only; "
+                         "decode: engine loop + migrated admissions; "
+                         "both: the colocated engine (default)")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--blocks", type=int, default=65)
     ap.add_argument("--block-size", type=int, default=8)
@@ -505,11 +840,18 @@ def main(argv=None) -> int:
             tuple(int(x) for x in pair.split(":"))
             for pair in args.warmup_suffix_lens.split(",") if pair
         ]
-        engine.warmup(lens, blocks, suffix_buckets=buckets)
+        # a decode-capable replica may receive migrated KV for any of
+        # these prompt lengths: warm the import scatter per block count
+        imports = (
+            {pcfg.blocks_for(t) for t in lens}
+            if args.role != "prefill" else ()
+        )
+        engine.warmup(lens, blocks, suffix_buckets=buckets,
+                      import_counts=imports)
 
     rcfg = ReplicaConfig(
         args.rank, args.dir, host=args.host, port=args.port,
-        max_pending=args.max_pending,
+        max_pending=args.max_pending, role=args.role,
     )
     server = ReplicaServer(engine, rcfg)
     if args.handoff_out:

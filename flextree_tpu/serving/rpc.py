@@ -43,6 +43,8 @@ protocol is unit-testable against an in-memory ``socket.socketpair()``.
 
 from __future__ import annotations
 
+import base64
+import binascii
 import json
 import socket
 import struct
@@ -57,10 +59,13 @@ __all__ = [
     "RpcTornFrame",
     "RpcShed",
     "MAX_FRAME_BYTES",
+    "MAX_KV_CHUNK_BYTES",
     "encode_frame",
     "decode_frame_payload",
     "send_frame",
     "recv_frame",
+    "chunk_blob",
+    "join_chunks",
     "RpcConnection",
 ]
 
@@ -68,6 +73,13 @@ __all__ = [
 #: or adversarial length header must fail fast, not allocate gigabytes
 #: and stall the reader until the peer's OOM kills it
 MAX_FRAME_BYTES = 8 * 1024 * 1024
+
+#: raw bytes per KV-transfer chunk: base64 inflates 4/3 and the JSON
+#: envelope adds a trailer, so 2 MiB raw rides well under the 8 MiB
+#: frame cap while keeping a multi-block migration to a handful of
+#: frames — a streamed KV transfer is many bounded frames, never one
+#: frame sized to the payload
+MAX_KV_CHUNK_BYTES = 2 * 1024 * 1024
 
 _LEN = struct.Struct(">I")
 
@@ -215,6 +227,36 @@ def recv_frame(
             f"(max {max_frame})"
         )
     return decode_frame_payload(_recv_exact(sock, length))
+
+
+def chunk_blob(blob: bytes, *, chunk_bytes: int = MAX_KV_CHUNK_BYTES) -> list:
+    """Split a binary payload into base64 strings, each from at most
+    ``chunk_bytes`` raw bytes, for streaming over JSON frames.  Always at
+    least one chunk (an empty payload is one empty chunk) so a transfer
+    has a well-defined ``total`` and a final frame to hang the metadata
+    on."""
+    if chunk_bytes < 1:
+        raise ValueError(f"chunk_bytes must be >= 1, got {chunk_bytes}")
+    if not blob:
+        return [""]
+    return [
+        base64.b64encode(blob[i : i + chunk_bytes]).decode("ascii")
+        for i in range(0, len(blob), chunk_bytes)
+    ]
+
+
+def join_chunks(chunks) -> bytes:
+    """Reassemble :func:`chunk_blob` output; undecodable base64 is a
+    framing-class violation (:class:`RpcTornFrame`) — the CRC check in
+    ``migration.unpack_kv`` guards the CONTENT, this guards the
+    transport encoding."""
+    try:
+        return b"".join(
+            base64.b64decode(c.encode("ascii"), validate=True)
+            for c in chunks
+        )
+    except (binascii.Error, UnicodeEncodeError, AttributeError) as e:
+        raise RpcTornFrame(f"undecodable KV chunk: {e}") from e
 
 
 # --------------------------------------------------------------------------

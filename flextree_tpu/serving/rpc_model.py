@@ -32,15 +32,36 @@ expiry transition.
 
 Mutation: ``"replay_miss"`` (the idempotency store misses on replay —
 a retried rid re-executes on the same replica).
+
+:class:`MigrationModel` is the disaggregation twin: one rid's KV
+migration handshake (export → ship → admit-or-refuse → release) between
+a prefill replica and a decode replica, with the decode crash injectable
+at every phase.  Its invariants are the handoff's two safety claims — a
+crash mid-migration never LOSES the request (every quiescent state is a
+loud terminal) and never LEAKS the prefill-side export (the exported
+blocks are released on exactly the ack/abort edges
+``replica_main._handle_migrate`` releases them on).  Mutation:
+``"skip_release"`` (the abort paths — decode refusal, ship failure —
+skip ``engine.release_exported``, which is what makes the block leak
+reachable).
 """
 
 from __future__ import annotations
 
+from .migration import MigrationError
 from .rpc import RpcConnRefused, RpcShed, RpcTimeout
 
-__all__ = ["RpcModel", "RPC_MUTATIONS", "TERMINAL_STATUSES", "FAIL_CODES"]
+__all__ = [
+    "RpcModel",
+    "RPC_MUTATIONS",
+    "TERMINAL_STATUSES",
+    "FAIL_CODES",
+    "MigrationModel",
+    "MIGRATION_MUTATIONS",
+]
 
 RPC_MUTATIONS = ("replay_miss",)
+MIGRATION_MUTATIONS = ("skip_release",)
 
 # the exactly-one-of terminal set ("every rid lands in exactly one of
 # completed-once / shed / failed")
@@ -252,6 +273,156 @@ class RpcModel:
                 f"completed rid delivered {delivered} results",
             ))
         return viols, truncated
+
+
+class MigrationModel:
+    """State = ``(status, exported, decode_alive, decode_has_seq,
+    attempts, crashes)``.
+
+    ``status`` is the front door's view of the rid: ``inflight`` (no
+    handoff running — the colocated fallback and the deadline live
+    here), ``exported`` (prefill done, blocks parked in
+    ``engine._exported``, ship unresolved), ``admitted`` (decode
+    verified + admitted, ack delivered to the prefill side),
+    ``handed_off`` (export released on ack; the sequence lives on the
+    decode replica), and the terminals ``completed`` / ``failed``.
+    ``exported`` tracks the prefill-side blocks the release handshake
+    must free exactly once; ``decode_has_seq`` tracks whether the decode
+    replica holds the migrated sequence (dies with the process — paged
+    blocks are process memory, so a crash frees them and is never a
+    leak).  Budgets: ``attempts`` bounds front-door launches (export,
+    local fallback, collect re-route), ``crashes`` bounds decode-replica
+    deaths.
+
+    Honest limits: one rid, one prefill and one decode replica, the wire
+    abstracted to {ack, refuse, lost} (CRC/shape refusals of a poisoned
+    payload surface as ``refuse`` — the byte-level checks are
+    ``unpack_kv``'s tested layer), and the deadline only fires between
+    handoff rounds (the front door abandons between rounds; the replica
+    halves of a mid-flight handshake still run to their release edges,
+    which is exactly what the implementation's synchronous
+    ``_handle_migrate`` does)."""
+
+    name_prefix = "migration"
+
+    def __init__(self, *, attempts: int = 3, crashes: int = 2,
+                 mutation: str | None = None):
+        if mutation is not None and mutation not in MIGRATION_MUTATIONS:
+            raise ValueError(f"unknown migration mutation: {mutation}")
+        self.mutation = mutation
+        self.budget0 = (attempts, crashes)
+        self.name = f"{self.name_prefix}@1hop"
+        if mutation:
+            self.name += f"+{mutation}"
+
+    def initial(self):
+        return ("inflight", False, True, False) + self.budget0
+
+    def is_fault_label(self, label: str) -> bool:
+        return label.startswith(("crash", "drain"))
+
+    # ---- transitions -------------------------------------------------------
+
+    def transitions(self, state):
+        status, exported, alive, has_seq, attempts, crashes = state
+        out = []
+
+        def _abort(label, *, seq=has_seq):
+            # release_exported(acked=False) — the edge the
+            # ``skip_release`` mutation deletes
+            freed = exported if self.mutation == "skip_release" else False
+            out.append((label,
+                        ("inflight", freed, alive, seq, attempts, crashes),
+                        []))
+
+        if status == "inflight":
+            if attempts > 0 and not exported:
+                # prefill_for_migration: prefill + first token + export
+                out.append(("export",
+                            ("exported", True, alive, has_seq,
+                             attempts - 1, crashes), []))
+            if attempts > 0:
+                # the migrate-vs-local fallback: a colocated (or other
+                # decode-tier) replica serves the rid without the hop
+                out.append(("complete_local",
+                            ("completed", exported, alive, has_seq,
+                             attempts - 1, crashes), []))
+            # deadline expiry: the caller stops waiting
+            out.append((f"deadline({RpcTimeout.code})",
+                        ("failed", exported, alive, has_seq, attempts,
+                         crashes), []))
+
+        elif status == "exported":
+            if alive:
+                # admit-or-refuse, plus the ack lost in flight AFTER the
+                # decode side already admitted (reply torn mid-stream):
+                # the prefill side aborts either way, the decode side
+                # keeps the sequence it admitted
+                out.append(("admit_ack",
+                            ("admitted", exported, alive, True, attempts,
+                             crashes), []))
+                _abort(f"refuse({MigrationError.code})")
+                _abort("ship_lost_after_admit", seq=True)
+            else:
+                # receiver unreachable / died mid-stream
+                _abort(f"ship_fail({RpcConnRefused.code})")
+
+        elif status == "admitted":
+            # the ack already landed: release_exported(acked=True) is
+            # unconditional, crash or no crash on the decode side
+            out.append(("release_ack",
+                        ("handed_off", False, alive, has_seq, attempts,
+                         crashes), []))
+
+        elif status == "handed_off":
+            if alive:
+                out.append(("complete_remote",
+                            ("completed", exported, alive, has_seq,
+                             attempts, crashes), []))
+            elif attempts > 0:
+                # decode died holding the sequence: the collect attempt
+                # errors and the front door re-routes (the sequence died
+                # with the process — greedy decode recomputes bitwise)
+                out.append(("collect_retry",
+                            ("inflight", exported, alive, False,
+                             attempts - 1, crashes), []))
+            else:
+                out.append((f"deadline({RpcTimeout.code})",
+                            ("failed", exported, alive, has_seq, attempts,
+                             crashes), []))
+
+        # -- fault injection: the decode replica can crash at any phase;
+        #    its admitted sequence (and blocks) die with the process
+        if crashes > 0 and alive and status not in ("completed", "failed"):
+            out.append(("crash(decode)",
+                        (status, exported, False, False, attempts,
+                         crashes - 1), []))
+        return out
+
+    # ---- invariants --------------------------------------------------------
+
+    def state_violations(self, state):
+        return []
+
+    def quiescent_violations(self, state):
+        status, exported, alive, has_seq, attempts, crashes = state
+        viols = []
+        if status not in ("completed", "failed"):
+            viols.append((
+                "unresolved-rid",
+                f"quiescent with rid status {status} — a crash mid-"
+                "migration must resolve to a loud terminal, never lose "
+                "the request",
+            ))
+        if exported:
+            viols.append((
+                "migration-block-leak",
+                "quiescent with the prefill-side export still held — "
+                "release_exported must run on every ack AND abort edge, "
+                "or each failed handoff leaks blocks_for(prompt) blocks "
+                "until the pool starves",
+            ))
+        return viols, False
 
 
 def _set(tup, i, row):

@@ -52,10 +52,13 @@ from flextree_tpu.runtime.leases import (
     ServeLeaseClient,
 )
 from flextree_tpu.serving.rpc import RpcConnRefused, RpcShed, RpcTimeout
+from flextree_tpu.serving.migration import MigrationError
 from flextree_tpu.serving.rpc_model import (
     FAIL_CODES,
+    MIGRATION_MUTATIONS,
     RPC_MUTATIONS,
     TERMINAL_STATUSES,
+    MigrationModel,
     RpcModel,
 )
 
@@ -70,6 +73,7 @@ STATE_SPACE_PINS = {
     "coordination@4ranks": (61499, 150448),
     "lease@2chips": (21250, 70584),
     "rpc@2replicas": (3445, 12301),
+    "migration@1hop": (51, 75),
 }
 
 
@@ -194,6 +198,13 @@ MUTATION_REACHABILITY = {
         lambda: RpcModel(mutation="replay_miss"),
         {"completed-rid-reexecuted"},
     ),
+    # the migration abort paths (decode refusal, ship failure) skip
+    # release_exported: every failed handoff leaks the prefill-side
+    # blocks — the block-accounting half of the handshake's safety claim
+    "skip_release": (
+        lambda: MigrationModel(mutation="skip_release"),
+        {"migration-block-leak"},
+    ),
 }
 
 
@@ -201,7 +212,7 @@ class TestMutatedModels:
     def test_every_declared_mutation_is_covered(self):
         declared = set(COORD_MUTATIONS) | set(LEASE_MUTATIONS) | set(
             RPC_MUTATIONS
-        )
+        ) | set(MIGRATION_MUTATIONS)
         assert declared == set(MUTATION_REACHABILITY)
 
     @pytest.mark.parametrize(
@@ -238,6 +249,8 @@ class TestMutatedModels:
             LeaseModel(mutation="nope")
         with pytest.raises(ValueError):
             RpcModel(mutation="nope")
+        with pytest.raises(ValueError):
+            MigrationModel(mutation="nope")
 
 
 # --------------------------------------------------- implementation pins
@@ -265,6 +278,20 @@ class TestModelConformance:
         )
         assert len(set(FAIL_CODES)) == 3
         assert TERMINAL_STATUSES == ("completed", "shed", "failed")
+
+    def test_migration_model_refusal_is_production_code(self):
+        """The model's refuse label carries the code ``unpack_kv`` /
+        ``admit_migrated`` actually raise with — imported, not
+        restated."""
+        assert MigrationError.code == "FT_MIGRATION_REFUSED"
+        m = MigrationModel()
+        labels = [
+            label for label, _, _ in m.transitions(
+                ("exported", True, True, False, 2, 1)
+            )
+        ]
+        assert f"refuse({MigrationError.code})" in labels
+        assert f"ship_fail({RpcConnRefused.code})" not in labels  # alive
 
     # ---- model-derived traces against the REAL ledgers ----------------
 
